@@ -1,0 +1,40 @@
+"""mmlspark_tpu — a TPU-native ML framework with the capabilities of MMLSpark.
+
+A brand-new, TPU-first re-imagining of MMLSpark (Microsoft ML for Apache Spark):
+the Estimator/Transformer pipeline surface, distributed LightGBM-style gradient
+boosting, deep-network batch inference and featurization, image transforms,
+auto-featurization / AutoML utilities, SAR recommendations, HTTP integration and
+model serving — all built on JAX/XLA/Pallas/pjit instead of CNTK, LightGBM C++
+and OpenCV native backends.
+
+Reference layer map: /root/reference (see SURVEY.md). The compute path is
+JAX on TPU (MXU matmuls in bfloat16, Pallas kernels for histogram ops, psum
+over ICI for data-parallel reductions); the runtime around it is Python + a
+C++ data-plane extension.
+"""
+
+__version__ = "0.1.0"
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.core.pipeline import (
+    Estimator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    PipelineStage,
+    Transformer,
+)
+from mmlspark_tpu.core.params import Param, Params
+
+__all__ = [
+    "DataFrame",
+    "DataType",
+    "Estimator",
+    "Model",
+    "Param",
+    "Params",
+    "Pipeline",
+    "PipelineModel",
+    "PipelineStage",
+    "Transformer",
+]

@@ -1,0 +1,5 @@
+"""Core runtime: params, dataframe, pipeline, schema, serialization, config.
+
+Equivalent role to the reference's `src/core` (SURVEY.md §2.1): the L1 layer
+every other module depends on.
+"""

@@ -1,0 +1,61 @@
+"""Framework configuration namespace.
+
+Reference: Typesafe-config `mmlspark.*` namespace
+(src/core/env/src/main/scala/Configuration.scala:18-52). Here: a process-wide
+dict seeded from MMLSPARK_TPU_* environment variables, with dotted-key
+get/set. Also central logging setup (reference: Logging.scala).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict
+
+_ENV_PREFIX = "MMLSPARK_TPU_"
+_lock = threading.Lock()
+_config: Dict[str, Any] = {}
+_loaded = False
+
+_DEFAULTS: Dict[str, Any] = {
+    "sdk.logging.level": "INFO",
+    "model.cache.dir": os.path.expanduser("~/.cache/mmlspark_tpu/models"),
+    "serving.default.port": 8899,
+    "gbdt.default.listen.timeout": 120.0,
+}
+
+
+def _load() -> None:
+    global _loaded
+    with _lock:
+        if _loaded:
+            return
+        _config.update(_DEFAULTS)
+        for key, value in os.environ.items():
+            if key.startswith(_ENV_PREFIX):
+                dotted = key[len(_ENV_PREFIX):].lower().replace("_", ".")
+                _config[dotted] = value
+        _loaded = True
+
+
+def get(key: str, default: Any = None) -> Any:
+    _load()
+    return _config.get(key, default)
+
+
+def set(key: str, value: Any) -> None:
+    _load()
+    _config[key] = value
+
+
+def get_logger(name: str = "mmlspark_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers and not logging.getLogger().handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+        logger.addHandler(handler)
+        logger.setLevel(str(get("sdk.logging.level", "INFO")))
+    return logger

@@ -1,0 +1,570 @@
+"""Columnar, partition-aware DataFrame — the data plane of the framework.
+
+The reference operates on Spark DataFrames; the TPU-native equivalent is a
+lightweight columnar table whose columns are numpy arrays (host) that shard
+cleanly onto a `jax.sharding.Mesh` (device). Design goals:
+
+- **Columnar**: each column is one contiguous ndarray → zero-copy
+  `jax.device_put` onto HBM, batched MXU-friendly compute, no per-row
+  marshalling (the reference's per-row SWIG `setitem` copy at
+  LightGBMUtils.scala:316-395 is the anti-pattern this design removes).
+- **Partitioned**: `num_partitions` is logical; `partitions()` yields row
+  slices so "one partition ≈ one worker/chip" semantics from the reference's
+  test strategy (SURVEY.md §4) carry over directly.
+- **Schema + metadata**: per-column `DataType` and a metadata dict carrying
+  categorical levels / image schema, mirroring Spark column metadata
+  (reference: core/schema Categoricals.scala:16-290).
+
+Vector columns are 2-D float arrays (n_rows, dim) — the reference's
+ml.linalg.Vector column becomes a dense matrix, which is what the TPU wants.
+Ragged data (strings, bytes, variable-length lists, image structs) uses
+object-dtype arrays and stays host-side.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+
+class DataType(enum.Enum):
+    DOUBLE = "double"
+    FLOAT = "float"
+    INT = "int"
+    LONG = "long"
+    BOOLEAN = "boolean"
+    STRING = "string"
+    BINARY = "binary"       # python bytes per row
+    VECTOR = "vector"       # fixed-dim dense vector -> 2D float array
+    IMAGE = "image"         # dict row: {height,width,nChannels,mode,data}
+    ARRAY = "array"         # variable-length python list per row
+    STRUCT = "struct"       # dict per row
+    TIMESTAMP = "timestamp" # numpy datetime64[us]
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in (DataType.DOUBLE, DataType.FLOAT, DataType.INT, DataType.LONG, DataType.BOOLEAN)
+
+
+_NUMPY_KIND_TO_TYPE = {
+    "f": {4: DataType.FLOAT, 8: DataType.DOUBLE, 2: DataType.FLOAT},
+    "i": {1: DataType.INT, 2: DataType.INT, 4: DataType.INT, 8: DataType.LONG},
+    "u": {1: DataType.INT, 2: DataType.INT, 4: DataType.LONG, 8: DataType.LONG},
+    "b": {1: DataType.BOOLEAN},
+}
+
+_TYPE_TO_NUMPY = {
+    DataType.DOUBLE: np.float64,
+    DataType.FLOAT: np.float32,
+    DataType.INT: np.int32,
+    DataType.LONG: np.int64,
+    DataType.BOOLEAN: np.bool_,
+    DataType.TIMESTAMP: "datetime64[us]",
+}
+
+
+def _infer_type(values: np.ndarray) -> DataType:
+    if values.dtype == object:
+        for v in values:
+            if v is None:
+                continue
+            if isinstance(v, str):
+                return DataType.STRING
+            if isinstance(v, (bytes, bytearray)):
+                return DataType.BINARY
+            if isinstance(v, dict):
+                return DataType.STRUCT
+            if isinstance(v, (list, tuple, np.ndarray)):
+                return DataType.ARRAY
+            if isinstance(v, bool):
+                return DataType.BOOLEAN
+            if isinstance(v, (int, np.integer)):
+                return DataType.LONG
+            if isinstance(v, (float, np.floating)):
+                return DataType.DOUBLE
+        return DataType.STRING
+    if values.ndim == 2:
+        return DataType.VECTOR
+    if values.dtype.kind == "U" or values.dtype.kind == "S":
+        return DataType.STRING
+    if values.dtype.kind == "M":
+        return DataType.TIMESTAMP
+    kinds = _NUMPY_KIND_TO_TYPE.get(values.dtype.kind)
+    if kinds is None:
+        raise TypeError(f"Cannot infer DataType for numpy dtype {values.dtype}")
+    return kinds[values.dtype.itemsize]
+
+
+class Field:
+    """Schema entry: column name, type, and a metadata dict.
+
+    metadata keys used across the framework:
+      - "categorical": {"levels": [...], "ordinal": bool} — reference
+        CategoricalMap (Categoricals.scala:16-290)
+      - "ml_attr": one-hot slot names for assembled feature vectors
+    """
+
+    def __init__(self, name: str, dtype: DataType, metadata: Optional[dict] = None):
+        self.name = name
+        self.dtype = dtype
+        self.metadata = metadata or {}
+
+    def __repr__(self) -> str:
+        meta = f", meta={list(self.metadata)}" if self.metadata else ""
+        return f"Field({self.name!r}, {self.dtype.value}{meta})"
+
+    def copy(self) -> "Field":
+        return Field(self.name, self.dtype, dict(self.metadata))
+
+
+class Column:
+    """A named array + type + metadata. Values is always a numpy ndarray:
+    1-D for scalars/objects, 2-D (n, dim) for VECTOR."""
+
+    def __init__(self, values: Any, dtype: Optional[DataType] = None, metadata: Optional[dict] = None):
+        if not isinstance(values, np.ndarray):
+            values = _to_array(values)
+        if dtype is None:
+            dtype = _infer_type(values)
+        if dtype == DataType.VECTOR and values.ndim != 2:
+            # rows of array-likes -> dense 2D
+            values = np.stack([np.asarray(v, dtype=np.float64) for v in values])
+        self.values = values
+        self.dtype = dtype
+        self.metadata = metadata or {}
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def __repr__(self) -> str:
+        return f"Column({self.dtype.value}, n={len(self)})"
+
+    def slice(self, start: int, stop: int) -> "Column":
+        return Column(self.values[start:stop], self.dtype, dict(self.metadata))
+
+    def take(self, indices: np.ndarray) -> "Column":
+        return Column(self.values[indices], self.dtype, dict(self.metadata))
+
+    def copy(self) -> "Column":
+        return Column(self.values, self.dtype, dict(self.metadata))
+
+
+def _to_array(values: Any) -> np.ndarray:
+    """Convert a python sequence to the canonical ndarray representation."""
+    if isinstance(values, np.ndarray):
+        return values
+    values = list(values)
+    if values and isinstance(values[0], str):
+        return np.array(values, dtype=object)
+    if values and isinstance(values[0], (bytes, bytearray, dict)):
+        arr = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = v
+        return arr
+    if values and isinstance(values[0], (list, tuple, np.ndarray)):
+        first_len = len(values[0])
+        if all(
+            isinstance(v, (list, tuple, np.ndarray))
+            and len(v) == first_len
+            and all(isinstance(x, (int, float, np.integer, np.floating)) for x in np.ravel(np.asarray(v, dtype=object))[:1])
+            for v in values
+        ):
+            try:
+                return np.array([np.asarray(v, dtype=np.float64) for v in values])
+            except (ValueError, TypeError):
+                pass
+        arr = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = v
+        return arr
+    try:
+        arr = np.asarray(values)
+        if arr.dtype.kind in "fiubM":
+            return arr
+    except (ValueError, TypeError):
+        pass
+    arr = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        arr[i] = v
+    return arr
+
+
+class DataFrame:
+    """Immutable-by-convention columnar table.
+
+    Construction:
+      DataFrame.from_dict({"a": [1,2,3], "b": ["x","y","z"]})
+      DataFrame.from_rows([{"a": 1}, {"a": 2}])
+    """
+
+    def __init__(self, columns: "Dict[str, Column]", num_partitions: int = 1):
+        lengths = {name: len(col) for name, col in columns.items()}
+        if len(set(lengths.values())) > 1:
+            raise ValueError(f"Column length mismatch: {lengths}")
+        self._columns: Dict[str, Column] = dict(columns)
+        self.num_partitions = max(1, num_partitions)
+
+    # -- construction ---------------------------------------------------------
+
+    @staticmethod
+    def from_dict(data: Dict[str, Any], num_partitions: int = 1,
+                  types: Optional[Dict[str, DataType]] = None,
+                  metadata: Optional[Dict[str, dict]] = None) -> "DataFrame":
+        types = types or {}
+        metadata = metadata or {}
+        cols = {
+            name: Column(values, types.get(name), metadata.get(name))
+            for name, values in data.items()
+        }
+        return DataFrame(cols, num_partitions)
+
+    @staticmethod
+    def from_rows(rows: Sequence[Dict[str, Any]], num_partitions: int = 1) -> "DataFrame":
+        if not rows:
+            return DataFrame({}, num_partitions)
+        names = list(rows[0].keys())
+        return DataFrame.from_dict(
+            {n: [r.get(n) for r in rows] for n in names}, num_partitions
+        )
+
+    # -- basic info -----------------------------------------------------------
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._columns.keys())
+
+    def __len__(self) -> int:
+        if not self._columns:
+            return 0
+        return len(next(iter(self._columns.values())))
+
+    count = __len__
+
+    @property
+    def schema(self) -> List[Field]:
+        return [Field(n, c.dtype, dict(c.metadata)) for n, c in self._columns.items()]
+
+    def field(self, name: str) -> Field:
+        col = self.column(name)
+        return Field(name, col.dtype, dict(col.metadata))
+
+    def column(self, name: str) -> Column:
+        if name not in self._columns:
+            raise KeyError(f"No column {name!r}; have {self.columns}")
+        return self._columns[name]
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self.column(name).values
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._columns
+
+    def dtype(self, name: str) -> DataType:
+        return self.column(name).dtype
+
+    def metadata(self, name: str) -> dict:
+        return self.column(name).metadata
+
+    # -- projection / mutation (returns new DataFrame) ------------------------
+
+    def select(self, *names: str) -> "DataFrame":
+        flat: List[str] = []
+        for n in names:
+            flat.extend(n if isinstance(n, (list, tuple)) else [n])
+        return DataFrame({n: self.column(n) for n in flat}, self.num_partitions)
+
+    def drop(self, *names: str) -> "DataFrame":
+        flat = set()
+        for n in names:
+            flat.update(n if isinstance(n, (list, tuple)) else [n])
+        return DataFrame(
+            {n: c for n, c in self._columns.items() if n not in flat},
+            self.num_partitions,
+        )
+
+    def with_column(self, name: str, values: Any, dtype: Optional[DataType] = None,
+                    metadata: Optional[dict] = None) -> "DataFrame":
+        col = values if isinstance(values, Column) else Column(values, dtype, metadata)
+        if metadata is not None and not isinstance(values, Column):
+            col.metadata = metadata
+        new = dict(self._columns)
+        new[name] = col
+        return DataFrame(new, self.num_partitions)
+
+    def with_metadata(self, name: str, metadata: dict) -> "DataFrame":
+        col = self.column(name)
+        new = dict(self._columns)
+        new[name] = Column(col.values, col.dtype, dict(metadata))
+        return DataFrame(new, self.num_partitions)
+
+    def rename(self, existing: str, new_name: str) -> "DataFrame":
+        cols = {}
+        for n, c in self._columns.items():
+            cols[new_name if n == existing else n] = c
+        return DataFrame(cols, self.num_partitions)
+
+    def filter(self, mask: np.ndarray) -> "DataFrame":
+        mask = np.asarray(mask)
+        if mask.dtype == bool:
+            idx = np.nonzero(mask)[0]
+        else:
+            idx = mask
+        return DataFrame(
+            {n: c.take(idx) for n, c in self._columns.items()}, self.num_partitions
+        )
+
+    def take(self, n: int) -> "DataFrame":
+        return self.limit(n)
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(
+            {name: c.slice(0, n) for name, c in self._columns.items()},
+            self.num_partitions,
+        )
+
+    def sort(self, by: str, ascending: bool = True) -> "DataFrame":
+        order = np.argsort(self[by], kind="stable")
+        if not ascending:
+            order = order[::-1]
+        return self.filter(order)
+
+    def sample(self, fraction: float, seed: int = 0, replace: bool = False) -> "DataFrame":
+        rng = np.random.default_rng(seed)
+        n = len(self)
+        if replace:
+            idx = rng.integers(0, n, size=int(round(n * fraction)))
+        else:
+            idx = np.nonzero(rng.random(n) < fraction)[0]
+        return self.filter(idx)
+
+    def random_split(self, weights: Sequence[float], seed: int = 0) -> List["DataFrame"]:
+        rng = np.random.default_rng(seed)
+        n = len(self)
+        w = np.asarray(weights, dtype=np.float64)
+        w = w / w.sum()
+        assignment = rng.choice(len(w), size=n, p=w)
+        return [self.filter(assignment == i) for i in range(len(w))]
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        if set(self.columns) != set(other.columns):
+            raise ValueError(f"union column mismatch: {self.columns} vs {other.columns}")
+        cols = {}
+        for n, c in self._columns.items():
+            oc = other.column(n)
+            cols[n] = Column(np.concatenate([c.values, oc.values]), c.dtype, dict(c.metadata))
+        return DataFrame(cols, self.num_partitions)
+
+    def distinct(self) -> "DataFrame":
+        keys = list(zip(*(self._hashable_col(n) for n in self.columns))) if self.columns else []
+        seen: Dict[Any, int] = {}
+        idx = []
+        for i, k in enumerate(keys):
+            if k not in seen:
+                seen[k] = i
+                idx.append(i)
+        return self.filter(np.asarray(idx, dtype=np.int64))
+
+    def drop_na(self, subset: Optional[List[str]] = None) -> "DataFrame":
+        names = subset or self.columns
+        mask = np.ones(len(self), dtype=bool)
+        for n in names:
+            col = self.column(n)
+            v = col.values
+            if col.dtype in (DataType.DOUBLE, DataType.FLOAT):
+                mask &= ~np.isnan(v.astype(np.float64) if v.ndim == 1 else v.astype(np.float64).sum(axis=1))
+            elif v.dtype == object:
+                mask &= np.array([x is not None for x in v])
+        return self.filter(mask)
+
+    def _hashable_col(self, name: str) -> list:
+        v = self[name]
+        if v.ndim == 2:
+            return [tuple(row) for row in v]
+        return [x.item() if isinstance(x, np.generic) else x for x in v]
+
+    # -- group/join (host-side relational ops used by SAR, stats, LIME) --------
+
+    def group_by(self, *keys: str) -> "GroupedData":
+        return GroupedData(self, list(keys))
+
+    def join(self, other: "DataFrame", on: Union[str, List[str]], how: str = "inner") -> "DataFrame":
+        on_cols = [on] if isinstance(on, str) else list(on)
+        left_keys = list(zip(*(self._hashable_col(k) for k in on_cols)))
+        right_keys = list(zip(*(other._hashable_col(k) for k in on_cols)))
+        right_index: Dict[Any, List[int]] = {}
+        for i, k in enumerate(right_keys):
+            right_index.setdefault(k, []).append(i)
+        li, ri = [], []
+        matched_right: set = set()
+        for i, k in enumerate(left_keys):
+            hits = right_index.get(k)
+            if hits:
+                for j in hits:
+                    li.append(i)
+                    ri.append(j)
+                    matched_right.add(j)
+            elif how in ("left", "left_outer", "outer", "full"):
+                li.append(i)
+                ri.append(-1)
+        if how in ("right", "right_outer", "outer", "full"):
+            for j in range(len(right_keys)):
+                if j not in matched_right:
+                    li.append(-1)
+                    ri.append(j)
+        li_arr = np.asarray(li, dtype=np.int64)
+        ri_arr = np.asarray(ri, dtype=np.int64)
+        cols: Dict[str, Column] = {}
+        for n, c in self._columns.items():
+            cols[n] = _gather_with_null(c, li_arr)
+        for n, c in other._columns.items():
+            if n in on_cols:
+                # fill join keys from whichever side matched
+                merged = _gather_with_null(c, ri_arr)
+                base = cols[n]
+                vals = base.values.copy()
+                fill = li_arr < 0
+                if fill.any():
+                    vals[fill] = merged.values[fill]
+                cols[n] = Column(vals, base.dtype, dict(base.metadata))
+                continue
+            name = n if n not in cols else f"{n}_right"
+            cols[name] = _gather_with_null(c, ri_arr)
+        return DataFrame(cols, self.num_partitions)
+
+    # -- partitioning (logical workers; SURVEY.md §2.7 item 1) -----------------
+
+    def repartition(self, n: int) -> "DataFrame":
+        return DataFrame(dict(self._columns), num_partitions=n)
+
+    def coalesce(self, n: int) -> "DataFrame":
+        return self.repartition(min(n, self.num_partitions))
+
+    def partition_bounds(self) -> List[Tuple[int, int]]:
+        n = len(self)
+        k = min(self.num_partitions, max(1, n)) if n else 1
+        sizes = [n // k + (1 if i < n % k else 0) for i in range(k)]
+        bounds, start = [], 0
+        for s in sizes:
+            bounds.append((start, start + s))
+            start += s
+        return bounds
+
+    def partitions(self) -> Iterator["DataFrame"]:
+        for start, stop in self.partition_bounds():
+            yield DataFrame(
+                {n: c.slice(start, stop) for n, c in self._columns.items()},
+                num_partitions=1,
+            )
+
+    def map_partitions(self, fn: Callable[["DataFrame"], "DataFrame"]) -> "DataFrame":
+        parts = [fn(p) for p in self.partitions()]
+        out = parts[0]
+        for p in parts[1:]:
+            out = out.union(p)
+        return out.repartition(self.num_partitions)
+
+    # -- materialization -------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, np.ndarray]:
+        return {n: c.values for n, c in self._columns.items()}
+
+    def collect(self) -> List[Dict[str, Any]]:
+        names = self.columns
+        out = []
+        for i in range(len(self)):
+            row = {}
+            for n in names:
+                v = self._columns[n].values[i]
+                row[n] = v.item() if isinstance(v, np.generic) else v
+            out.append(row)
+        return out
+
+    def head(self, n: int = 5) -> List[Dict[str, Any]]:
+        return self.limit(n).collect()
+
+    def cache(self) -> "DataFrame":
+        return self  # eager already; hook kept for API parity (Cacher stage)
+
+    def __repr__(self) -> str:
+        fields = ", ".join(f"{f.name}: {f.dtype.value}" for f in self.schema)
+        return f"DataFrame[{fields}] (n={len(self)}, partitions={self.num_partitions})"
+
+    def show(self, n: int = 10) -> None:
+        print(self.__repr__())
+        for row in self.head(n):
+            print(row)
+
+
+def _gather_with_null(col: Column, idx: np.ndarray) -> Column:
+    """Gather rows by index; index -1 produces a null (NaN / None / 0)."""
+    has_null = (idx < 0).any()
+    safe = np.where(idx < 0, 0, idx)
+    vals = col.values[safe]
+    if has_null:
+        nulls = idx < 0
+        if vals.dtype == object:
+            vals = vals.copy()
+            vals[nulls] = None
+        elif vals.dtype.kind == "f" or col.dtype == DataType.VECTOR:
+            vals = vals.astype(np.float64, copy=True)
+            vals[nulls] = np.nan
+        else:
+            vals = vals.astype(np.float64)
+            vals[nulls] = np.nan
+            return Column(vals, DataType.DOUBLE, dict(col.metadata))
+    return Column(vals, col.dtype, dict(col.metadata))
+
+
+class GroupedData:
+    """Minimal groupBy support: agg with named aggregations, and apply()."""
+
+    _AGGS = {
+        "sum": np.sum,
+        "mean": np.mean,
+        "avg": np.mean,
+        "min": np.min,
+        "max": np.max,
+        "count": len,
+        "first": lambda v: v[0],
+        "collect_list": list,
+    }
+
+    def __init__(self, df: DataFrame, keys: List[str]):
+        self.df = df
+        self.keys = keys
+        self._groups: Dict[Any, List[int]] = {}
+        key_cols = [df._hashable_col(k) for k in keys]
+        for i, key in enumerate(zip(*key_cols)):
+            self._groups.setdefault(key, []).append(i)
+
+    def agg(self, **named_aggs: Tuple[str, str]) -> DataFrame:
+        """agg(total=("amount","sum"), n=("amount","count"))"""
+        out: Dict[str, list] = {k: [] for k in self.keys}
+        for name in named_aggs:
+            out[name] = []
+        for key, idx in self._groups.items():
+            for kname, kval in zip(self.keys, key):
+                out[kname].append(kval)
+            for name, (src, how) in named_aggs.items():
+                vals = self.df[src][np.asarray(idx)]
+                out[name].append(self._AGGS[how](vals))
+        return DataFrame.from_dict(out, self.df.num_partitions)
+
+    def apply(self, fn: Callable[[Tuple, DataFrame], Dict[str, Any]]) -> DataFrame:
+        """mapGroups: fn(key_tuple, group_df) -> one output row (dict)."""
+        rows = []
+        for key, idx in self._groups.items():
+            group = self.df.filter(np.asarray(idx))
+            rows.append(fn(key, group))
+        return DataFrame.from_rows(rows, self.df.num_partitions)
+
+    def count(self) -> DataFrame:
+        out: Dict[str, list] = {k: [] for k in self.keys}
+        out["count"] = []
+        for key, idx in self._groups.items():
+            for kname, kval in zip(self.keys, key):
+                out[kname].append(kval)
+            out["count"].append(len(idx))
+        return DataFrame.from_dict(out, self.df.num_partitions)

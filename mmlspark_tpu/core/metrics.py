@@ -1,0 +1,38 @@
+"""Metric name constants shared by evaluation modules.
+
+Reference: core/metrics MetricConstants.scala / MetricUtils.scala.
+"""
+
+# classification
+ACCURACY = "accuracy"
+PRECISION = "precision"
+RECALL = "recall"
+AUC = "AUC"
+F1 = "f1"
+# regression
+MSE = "mse"
+RMSE = "rmse"
+R2 = "r2"
+MAE = "mae"
+# meta
+ALL = "all"
+
+CLASSIFICATION_METRICS = [ACCURACY, PRECISION, RECALL, AUC, F1]
+REGRESSION_METRICS = [MSE, RMSE, R2, MAE]
+
+# column names produced by scoring models (kept stable for API parity)
+SCORES_COL = "scores"
+SCORED_LABELS_COL = "scored_labels"
+SCORED_PROBABILITIES_COL = "scored_probabilities"
+PREDICTION_COL = "prediction"
+
+LARGER_IS_BETTER = {ACCURACY: True, PRECISION: True, RECALL: True, AUC: True, F1: True,
+                    MSE: False, RMSE: False, R2: True, MAE: False}
+
+
+def is_classification_metric(name: str) -> bool:
+    return name in CLASSIFICATION_METRICS
+
+
+def is_regression_metric(name: str) -> bool:
+    return name in REGRESSION_METRICS

@@ -1,0 +1,397 @@
+"""Typed parameter system for pipeline stages.
+
+TPU-native re-design of the reference's param machinery:
+- SparkML `Params` traits + MMLSpark's `Wrappable`/`Has*Col` mixins
+  (reference: src/core/contracts/src/main/scala/Params.scala:10-141)
+- the ComplexParam zoo for values JSON can't carry
+  (reference: src/core/serialize/src/main/scala/params/*.scala)
+
+Params metadata is the single source of truth for the public API: persistence
+(core/serialize.py), doc/wrapper generation (codegen/) and the fuzzing test
+harness all reflect over it, exactly as the reference's codegen reflects over
+Spark Params (src/codegen/src/main/scala/CodeGen.scala:44-98).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+
+class TypeConverters:
+    """Value coercion/validation helpers attached to `Param.type_converter`.
+
+    Mirrors the role of pyspark.ml.param.TypeConverters so generated wrappers
+    behave identically for users coming from the reference API.
+    """
+
+    @staticmethod
+    def to_int(value: Any) -> int:
+        if isinstance(value, bool):
+            raise TypeError(f"Could not convert {value!r} to int")
+        if isinstance(value, int):
+            return value
+        if isinstance(value, float) and value.is_integer():
+            return int(value)
+        raise TypeError(f"Could not convert {value!r} to int")
+
+    @staticmethod
+    def to_float(value: Any) -> float:
+        if isinstance(value, bool):
+            raise TypeError(f"Could not convert {value!r} to float")
+        if isinstance(value, (int, float)):
+            return float(value)
+        raise TypeError(f"Could not convert {value!r} to float")
+
+    @staticmethod
+    def to_string(value: Any) -> str:
+        if isinstance(value, str):
+            return value
+        raise TypeError(f"Could not convert {value!r} to str")
+
+    @staticmethod
+    def to_boolean(value: Any) -> bool:
+        if isinstance(value, bool):
+            return value
+        raise TypeError(f"Could not convert {value!r} to bool")
+
+    @staticmethod
+    def to_list(value: Any) -> list:
+        if isinstance(value, (list, tuple)):
+            return list(value)
+        raise TypeError(f"Could not convert {value!r} to list")
+
+    @staticmethod
+    def to_list_int(value: Any) -> List[int]:
+        return [TypeConverters.to_int(v) for v in TypeConverters.to_list(value)]
+
+    @staticmethod
+    def to_list_float(value: Any) -> List[float]:
+        return [TypeConverters.to_float(v) for v in TypeConverters.to_list(value)]
+
+    @staticmethod
+    def to_list_string(value: Any) -> List[str]:
+        return [TypeConverters.to_string(v) for v in TypeConverters.to_list(value)]
+
+    @staticmethod
+    def to_dict(value: Any) -> dict:
+        if isinstance(value, dict):
+            return dict(value)
+        raise TypeError(f"Could not convert {value!r} to dict")
+
+    @staticmethod
+    def identity(value: Any) -> Any:
+        return value
+
+
+class Param:
+    """A named, documented, typed parameter declared on a `Params` class.
+
+    Declared at class level; instances of the owning class carry values in
+    their own `_param_map`, so Param objects are shared and immutable.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        doc: str,
+        type_converter: Optional[Callable[[Any], Any]] = None,
+        is_complex: bool = False,
+    ):
+        self.name = name
+        self.doc = doc
+        self.type_converter = type_converter or TypeConverters.identity
+        # Complex params hold values JSON can't represent (models, arrays,
+        # callables); persistence routes them through ComplexParamIO.
+        self.is_complex = is_complex
+
+    def __repr__(self) -> str:
+        return f"Param({self.name!r})"
+
+    def __hash__(self) -> int:
+        return hash(self.name)
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Param) and other.name == self.name
+
+
+class ComplexParam(Param):
+    """Param whose value is an arbitrary object (stage, model, array, fn).
+
+    Reference: the 16 ComplexParam subtypes under
+    src/core/serialize/src/main/scala/params/ (EstimatorParam,
+    TransformerParam, UDFParam, DataFrameParam, ArrayParam, ...). Here a
+    single class suffices — Python values self-describe and serialize.py
+    dispatches on runtime type.
+    """
+
+    def __init__(self, name: str, doc: str):
+        super().__init__(name, doc, TypeConverters.identity, is_complex=True)
+
+
+class Params:
+    """Base class carrying a param map; every pipeline stage derives from it.
+
+    API kept close to pyspark.ml.param.Params (get/set/hasDefault/
+    explainParams/copy) so reference users can switch without relearning.
+    """
+
+    def __init__(self) -> None:
+        self._param_map: Dict[Param, Any] = {}
+        self._default_param_map: Dict[Param, Any] = {}
+        self.uid = f"{type(self).__name__}_{id(self):x}"
+
+    # -- declaration/introspection ------------------------------------------------
+
+    @classmethod
+    def params(cls) -> List[Param]:
+        """All Param objects declared on the class (and bases), sorted by name."""
+        seen: Dict[str, Param] = {}
+        for klass in reversed(cls.__mro__):
+            for value in vars(klass).values():
+                if isinstance(value, Param):
+                    seen[value.name] = value
+        return sorted(seen.values(), key=lambda p: p.name)
+
+    def get_param(self, name: str) -> Param:
+        for p in self.params():
+            if p.name == name:
+                return p
+        raise AttributeError(f"{type(self).__name__} has no param {name!r}")
+
+    def has_param(self, name: str) -> bool:
+        return any(p.name == name for p in self.params())
+
+    # -- get/set -------------------------------------------------------------------
+
+    def _resolve(self, param) -> Param:
+        if isinstance(param, str):
+            return self.get_param(param)
+        if not self.has_param(param.name):
+            raise AttributeError(
+                f"{type(self).__name__} has no param {param.name!r}"
+            )
+        return param
+
+    def set(self, param, value: Any) -> "Params":
+        param = self._resolve(param)
+        self._param_map[param] = param.type_converter(value)
+        return self
+
+    def set_params(self, **kwargs: Any) -> "Params":
+        for name, value in kwargs.items():
+            self.set(name, value)
+        return self
+
+    def _set_default(self, param, value: Any) -> "Params":
+        param = self._resolve(param)
+        self._default_param_map[param] = value
+        return self
+
+    def _set_defaults(self, **kwargs: Any) -> "Params":
+        for name, value in kwargs.items():
+            self._set_default(name, value)
+        return self
+
+    def is_set(self, param) -> bool:
+        return self._resolve(param) in self._param_map
+
+    def has_default(self, param) -> bool:
+        return self._resolve(param) in self._default_param_map
+
+    def is_defined(self, param) -> bool:
+        return self.is_set(param) or self.has_default(param)
+
+    def get(self, param) -> Any:
+        param = self._resolve(param)
+        if param in self._param_map:
+            return self._param_map[param]
+        if param in self._default_param_map:
+            return self._default_param_map[param]
+        raise KeyError(
+            f"Param {param.name!r} is not set and has no default on "
+            f"{type(self).__name__}"
+        )
+
+    def get_or_default(self, param, default: Any = None) -> Any:
+        param = self._resolve(param)
+        if self.is_defined(param):
+            return self.get(param)
+        return default
+
+    def clear(self, param) -> "Params":
+        self._param_map.pop(self._resolve(param), None)
+        return self
+
+    # -- docs / copy / compare ------------------------------------------------------
+
+    def explain_param(self, param) -> str:
+        param = self._resolve(param)
+        value_str = (
+            f"current: {self._param_map[param]!r}"
+            if param in self._param_map
+            else (
+                f"default: {self._default_param_map[param]!r}"
+                if param in self._default_param_map
+                else "undefined"
+            )
+        )
+        return f"{param.name}: {param.doc} ({value_str})"
+
+    def explain_params(self) -> str:
+        return "\n".join(self.explain_param(p) for p in self.params())
+
+    def copy(self, extra: Optional[Dict[Param, Any]] = None) -> "Params":
+        that = copy.copy(self)
+        that._param_map = dict(self._param_map)
+        that._default_param_map = dict(self._default_param_map)
+        if extra:
+            for param, value in extra.items():
+                that.set(param, value)
+        return that
+
+    def extract_param_map(self) -> Dict[Param, Any]:
+        merged = dict(self._default_param_map)
+        merged.update(self._param_map)
+        return merged
+
+    def _simple_params_json(self) -> str:
+        """JSON of all set non-complex params (for persistence metadata)."""
+        out = {}
+        for param, value in self._param_map.items():
+            if not param.is_complex:
+                out[param.name] = value
+        return json.dumps(out, sort_keys=True, default=str)
+
+    def _complex_params(self) -> Iterator[Tuple[Param, Any]]:
+        for param, value in self._param_map.items():
+            if param.is_complex:
+                yield param, value
+
+
+# ---------------------------------------------------------------------------
+# Shared column-param mixins (reference: core/contracts Params.scala:10-141).
+# These keep the input/output column contract uniform across every stage.
+# ---------------------------------------------------------------------------
+
+
+class HasInputCol(Params):
+    input_col = Param("input_col", "The name of the input column", TypeConverters.to_string)
+
+    def set_input_col(self, value: str):
+        return self.set(self.input_col, value)
+
+    def get_input_col(self) -> str:
+        return self.get(self.input_col)
+
+
+class HasOutputCol(Params):
+    output_col = Param("output_col", "The name of the output column", TypeConverters.to_string)
+
+    def set_output_col(self, value: str):
+        return self.set(self.output_col, value)
+
+    def get_output_col(self) -> str:
+        return self.get(self.output_col)
+
+
+class HasInputCols(Params):
+    input_cols = Param("input_cols", "The names of the input columns", TypeConverters.to_list_string)
+
+    def set_input_cols(self, value: List[str]):
+        return self.set(self.input_cols, value)
+
+    def get_input_cols(self) -> List[str]:
+        return self.get(self.input_cols)
+
+
+class HasOutputCols(Params):
+    output_cols = Param("output_cols", "The names of the output columns", TypeConverters.to_list_string)
+
+    def set_output_cols(self, value: List[str]):
+        return self.set(self.output_cols, value)
+
+    def get_output_cols(self) -> List[str]:
+        return self.get(self.output_cols)
+
+
+class HasLabelCol(Params):
+    label_col = Param("label_col", "The name of the label column", TypeConverters.to_string)
+
+    def set_label_col(self, value: str):
+        return self.set(self.label_col, value)
+
+    def get_label_col(self) -> str:
+        return self.get(self.label_col)
+
+
+class HasFeaturesCol(Params):
+    features_col = Param("features_col", "The name of the features column", TypeConverters.to_string)
+
+    def set_features_col(self, value: str):
+        return self.set(self.features_col, value)
+
+    def get_features_col(self) -> str:
+        return self.get(self.features_col)
+
+
+class HasWeightCol(Params):
+    weight_col = Param("weight_col", "The name of the weight column", TypeConverters.to_string)
+
+    def set_weight_col(self, value: str):
+        return self.set(self.weight_col, value)
+
+    def get_weight_col(self) -> str:
+        return self.get(self.weight_col)
+
+
+class HasScoredLabelsCol(Params):
+    scored_labels_col = Param(
+        "scored_labels_col",
+        "Scored labels column name, only required if using SparkML estimators",
+        TypeConverters.to_string,
+    )
+
+    def set_scored_labels_col(self, value: str):
+        return self.set(self.scored_labels_col, value)
+
+    def get_scored_labels_col(self) -> str:
+        return self.get(self.scored_labels_col)
+
+
+class HasScoresCol(Params):
+    scores_col = Param("scores_col", "Scores or raw prediction column name", TypeConverters.to_string)
+
+    def set_scores_col(self, value: str):
+        return self.set(self.scores_col, value)
+
+    def get_scores_col(self) -> str:
+        return self.get(self.scores_col)
+
+
+class HasScoredProbabilitiesCol(Params):
+    scored_probabilities_col = Param(
+        "scored_probabilities_col", "Scored probabilities column name", TypeConverters.to_string
+    )
+
+    def set_scored_probabilities_col(self, value: str):
+        return self.set(self.scored_probabilities_col, value)
+
+    def get_scored_probabilities_col(self) -> str:
+        return self.get(self.scored_probabilities_col)
+
+
+class HasEvaluationMetric(Params):
+    evaluation_metric = Param("evaluation_metric", "Metric to evaluate models with", TypeConverters.to_string)
+
+    def set_evaluation_metric(self, value: str):
+        return self.set(self.evaluation_metric, value)
+
+    def get_evaluation_metric(self) -> str:
+        return self.get(self.evaluation_metric)
+
+
+class Wrappable:
+    """Marker mixin: stage participates in doc/wrapper generation and the
+    whole-library fuzzing sweep (reference: Wrappable in core/contracts)."""

@@ -1,0 +1,138 @@
+"""Estimator / Transformer / Pipeline — the product API surface.
+
+Keeps the SparkML pipeline contract the reference extends (SURVEY.md §1 L4:
+"learners expose standard SparkML Estimator[M]/Model/Transformer classes") so
+users of the reference can switch frameworks without relearning:
+
+    model = Pipeline(stages=[featurize, classifier]).fit(df)
+    scored = model.transform(df)
+
+Persistence follows the reference's constructor-based scheme
+(src/core/serialize/src/main/scala/ConstructorWriter.scala): simple params as
+JSON, complex params via type-dispatched writers (core/serialize.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from mmlspark_tpu.core.dataframe import DataFrame, Field
+from mmlspark_tpu.core.params import ComplexParam, Params, Wrappable
+
+
+class PipelineStage(Params):
+    """Base of all pipeline stages."""
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        """Schema-only dry run; default passthrough. Stages override to
+        declare output columns so pipelines can be schema-checked pre-fit."""
+        return schema
+
+    # -- persistence ----------------------------------------------------------
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from mmlspark_tpu.core import serialize
+
+        serialize.save_stage(self, path, overwrite=overwrite)
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineStage":
+        from mmlspark_tpu.core import serialize
+
+        stage = serialize.load_stage(path)
+        if cls is not PipelineStage and not isinstance(stage, cls):
+            raise TypeError(f"Loaded {type(stage).__name__}, expected {cls.__name__}")
+        return stage
+
+    write = save
+    read = load
+
+
+class Transformer(PipelineStage):
+    def transform(self, df: DataFrame) -> DataFrame:
+        raise NotImplementedError
+
+    def __call__(self, df: DataFrame) -> DataFrame:
+        return self.transform(df)
+
+
+class Estimator(PipelineStage):
+    def fit(self, df: DataFrame) -> "Model":
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    """A fitted Transformer produced by an Estimator."""
+
+
+class Evaluator(Params):
+    """Computes a scalar metric over a scored DataFrame."""
+
+    def evaluate(self, df: DataFrame) -> float:
+        raise NotImplementedError
+
+    def is_larger_better(self) -> bool:
+        return True
+
+
+class Pipeline(Estimator, Wrappable):
+    """Chain of stages; fit() fits estimators in sequence, transforming the
+    running DataFrame through each fitted model (SparkML semantics)."""
+
+    stages_param = ComplexParam("stages", "The stages of the pipeline")
+
+    def __init__(self, stages: Optional[Sequence[PipelineStage]] = None):
+        super().__init__()
+        if stages is not None:
+            self.set_stages(list(stages))
+
+    def set_stages(self, stages: List[PipelineStage]) -> "Pipeline":
+        return self.set(self.stages_param, list(stages))
+
+    def get_stages(self) -> List[PipelineStage]:
+        return self.get(self.stages_param)
+
+    def fit(self, df: DataFrame) -> "PipelineModel":
+        fitted: List[Transformer] = []
+        current = df
+        stages = self.get_stages()
+        for i, stage in enumerate(stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(current)
+                fitted.append(model)
+                if i < len(stages) - 1:
+                    current = model.transform(current)
+            elif isinstance(stage, Transformer):
+                fitted.append(stage)
+                if i < len(stages) - 1:
+                    current = stage.transform(current)
+            else:
+                raise TypeError(f"Pipeline stage {stage!r} is neither Estimator nor Transformer")
+        return PipelineModel(fitted)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        for stage in self.get_stages():
+            schema = stage.transform_schema(schema)
+        return schema
+
+
+class PipelineModel(Model, Wrappable):
+    stages_param = ComplexParam("stages", "The fitted stages of the pipeline")
+
+    def __init__(self, stages: Optional[Sequence[Transformer]] = None):
+        super().__init__()
+        if stages is not None:
+            self.set(self.stages_param, list(stages))
+
+    def get_stages(self) -> List[Transformer]:
+        return self.get(self.stages_param)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        for stage in self.get_stages():
+            df = stage.transform(df)
+        return df
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        for stage in self.get_stages():
+            schema = stage.transform_schema(schema)
+        return schema

@@ -1,0 +1,126 @@
+"""Schema utilities: categorical metadata, image/binary schemas, helpers.
+
+TPU-native equivalents of the reference's core/schema:
+- CategoricalMap / CategoricalUtilities (Categoricals.scala:16-290)
+- ImageSchemaUtils (ImageSchemaUtils.scala:9-33)
+- BinaryFileSchema (BinaryFileSchema.scala)
+- DatasetExtensions.findUnusedColumnName
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType
+
+CATEGORICAL_KEY = "categorical"
+
+# Image rows are dicts with these keys; `data` is an HxWxC uint8 ndarray
+# (host representation; UnrollImage converts to CHW float vectors for TPU).
+IMAGE_FIELDS = ("path", "height", "width", "nChannels", "mode", "data")
+BINARY_FIELDS = ("path", "bytes")
+
+# OpenCV-compatible mode codes used by the reference's image schema
+IMAGE_MODE_CV8UC1 = 0
+IMAGE_MODE_CV8UC3 = 16
+IMAGE_MODE_CV8UC4 = 24
+
+
+def make_image_row(data: np.ndarray, path: str = "") -> Dict[str, Any]:
+    data = np.asarray(data)
+    if data.ndim == 2:
+        data = data[:, :, None]
+    h, w, c = data.shape
+    mode = {1: IMAGE_MODE_CV8UC1, 3: IMAGE_MODE_CV8UC3, 4: IMAGE_MODE_CV8UC4}[c]
+    return {
+        "path": path,
+        "height": int(h),
+        "width": int(w),
+        "nChannels": int(c),
+        "mode": mode,
+        "data": data.astype(np.uint8),
+    }
+
+
+def is_image(df: DataFrame, col: str) -> bool:
+    if col not in df or df.dtype(col) != DataType.STRUCT:
+        return False
+    values = df[col]
+    for v in values:
+        if v is None:
+            continue
+        return isinstance(v, dict) and {"height", "width", "nChannels", "data"} <= set(v)
+    return False
+
+
+def is_binary(df: DataFrame, col: str) -> bool:
+    return col in df and df.dtype(col) == DataType.BINARY
+
+
+class CategoricalMap:
+    """Bidirectional value<->index mapping stored in column metadata.
+
+    Reference: CategoricalMap (Categoricals.scala:16-290). Levels keep their
+    original python type (str/int/float/bool); `ordinal` marks ordered levels.
+    """
+
+    def __init__(self, levels: Sequence[Any], ordinal: bool = False):
+        self.levels = list(levels)
+        self.ordinal = ordinal
+        self._index = {v: i for i, v in enumerate(self.levels)}
+
+    def __len__(self) -> int:
+        return len(self.levels)
+
+    def get_index(self, value: Any) -> int:
+        try:
+            return self._index[value]
+        except KeyError:
+            raise ValueError(f"Value {value!r} not in categorical levels") from None
+
+    def get_index_option(self, value: Any, default: int = -1) -> int:
+        return self._index.get(value, default)
+
+    def get_level(self, index: int) -> Any:
+        return self.levels[index]
+
+    def to_metadata(self) -> dict:
+        return {CATEGORICAL_KEY: {"levels": self.levels, "ordinal": self.ordinal}}
+
+    @staticmethod
+    def from_metadata(metadata: dict) -> Optional["CategoricalMap"]:
+        info = metadata.get(CATEGORICAL_KEY)
+        if not info:
+            return None
+        return CategoricalMap(info["levels"], info.get("ordinal", False))
+
+
+def get_categorical_map(df: DataFrame, col: str) -> Optional[CategoricalMap]:
+    return CategoricalMap.from_metadata(df.metadata(col))
+
+
+def set_categorical_map(df: DataFrame, col: str, cmap: CategoricalMap) -> DataFrame:
+    meta = dict(df.metadata(col))
+    meta.update(cmap.to_metadata())
+    return df.with_metadata(col, meta)
+
+
+def find_unused_column_name(base: str, df_or_columns) -> str:
+    """Reference: DatasetExtensions.findUnusedColumnName."""
+    columns = df_or_columns.columns if isinstance(df_or_columns, DataFrame) else set(df_or_columns)
+    name = base
+    i = 1
+    while name in columns:
+        name = f"{base}_{i}"
+        i += 1
+    return name
+
+
+def to_numeric(col: Column) -> np.ndarray:
+    """Column -> float64 ndarray (1-D), for metric/stat computations."""
+    v = col.values
+    if v.dtype == object:
+        return np.array([float(x) for x in v], dtype=np.float64)
+    return v.astype(np.float64)

@@ -1,0 +1,36 @@
+"""Test harness bootstrap.
+
+Single-process multi-device test mode: 8 virtual CPU devices, the TPU analog
+of the reference's local[*] partition≈worker trick (SURVEY.md §4,
+LightGBMUtils.scala:147-155). Must set env before the first jax import.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_stage_dir(tmp_path):
+    return str(tmp_path / "stage")
+
+
+def assert_df_equal(a, b, rtol=1e-6, atol=1e-8):
+    """DataFrame equality (reference: DataFrameEquality in TestBase)."""
+    assert a.columns == b.columns, f"{a.columns} != {b.columns}"
+    assert len(a) == len(b)
+    for name in a.columns:
+        va, vb = a[name], b[name]
+        if va.dtype == object or vb.dtype == object:
+            assert list(va) == list(vb), f"column {name} differs"
+        else:
+            np.testing.assert_allclose(va, vb, rtol=rtol, atol=atol, err_msg=f"column {name}")

@@ -1,0 +1,221 @@
+"""Core L1 tests: params, dataframe, pipeline, schema, serialization."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    Params,
+    TypeConverters,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model, Pipeline, PipelineModel, Transformer
+from mmlspark_tpu.core import schema as S
+from mmlspark_tpu.core import serialize
+
+
+class _Stage(HasInputCol, HasOutputCol):
+    alpha = Param("alpha", "a float param", TypeConverters.to_float)
+    names = Param("names", "a list param", TypeConverters.to_list_string)
+    payload = ComplexParam("payload", "arbitrary object")
+
+    def __init__(self):
+        super().__init__()
+        self._set_defaults(alpha=1.5)
+
+
+class TestParams:
+    def test_declare_get_set(self):
+        s = _Stage()
+        assert s.get("alpha") == 1.5
+        s.set("alpha", 2)
+        assert s.get("alpha") == 2.0 and isinstance(s.get("alpha"), float)
+        s.set_input_col("x")
+        assert s.get_input_col() == "x"
+        with pytest.raises(AttributeError):
+            s.set("nope", 1)
+        with pytest.raises(TypeError):
+            s.set("alpha", "zzz")
+
+    def test_params_listing_and_explain(self):
+        s = _Stage()
+        names = [p.name for p in s.params()]
+        assert "alpha" in names and "input_col" in names and "payload" in names
+        assert "a float param" in s.explain_param("alpha")
+        assert "default: 1.5" in s.explain_param("alpha")
+
+    def test_copy_isolated(self):
+        s = _Stage().set("alpha", 3.0)
+        c = s.copy()
+        c.set("alpha", 4.0)
+        assert s.get("alpha") == 3.0 and c.get("alpha") == 4.0
+
+    def test_complex_param_split(self):
+        s = _Stage()
+        s.set("alpha", 2.0)
+        s.set("payload", np.zeros(3))
+        import json
+
+        simple = json.loads(s._simple_params_json())
+        assert simple == {"alpha": 2.0}
+        assert [p.name for p, _ in s._complex_params()] == ["payload"]
+
+
+class TestDataFrame:
+    def make(self):
+        return DataFrame.from_dict(
+            {
+                "a": [1.0, 2.0, 3.0, 4.0],
+                "b": ["x", "y", "x", "z"],
+                "v": np.arange(8.0).reshape(4, 2),
+            },
+            num_partitions=2,
+        )
+
+    def test_schema_inference(self):
+        df = self.make()
+        assert df.dtype("a") == DataType.DOUBLE
+        assert df.dtype("b") == DataType.STRING
+        assert df.dtype("v") == DataType.VECTOR
+        assert len(df) == 4
+
+    def test_select_drop_rename_withcol(self):
+        df = self.make()
+        assert df.select("a", "b").columns == ["a", "b"]
+        assert df.drop("b").columns == ["a", "v"]
+        assert df.rename("a", "aa").columns == ["aa", "b", "v"]
+        df2 = df.with_column("c", df["a"] * 2)
+        np.testing.assert_array_equal(df2["c"], [2.0, 4.0, 6.0, 8.0])
+
+    def test_filter_sort_limit(self):
+        df = self.make()
+        f = df.filter(df["a"] > 2)
+        assert list(f["b"]) == ["x", "z"]
+        s = df.sort("a", ascending=False)
+        assert s["a"][0] == 4.0
+        assert len(df.limit(2)) == 2
+
+    def test_partitions(self):
+        df = self.make()
+        parts = list(df.partitions())
+        assert len(parts) == 2
+        assert sum(len(p) for p in parts) == 4
+        out = df.map_partitions(lambda p: p.with_column("n", np.full(len(p), len(p))))
+        assert len(out) == 4
+
+    def test_union_distinct(self):
+        df = self.make()
+        u = df.union(df)
+        assert len(u) == 8
+        assert len(u.select("b").distinct()) == 3
+
+    def test_join(self):
+        left = DataFrame.from_dict({"k": ["a", "b", "c"], "x": [1.0, 2.0, 3.0]})
+        right = DataFrame.from_dict({"k": ["b", "c", "d"], "y": [20.0, 30.0, 40.0]})
+        inner = left.join(right, "k")
+        assert sorted(inner["k"]) == ["b", "c"]
+        outer = left.join(right, "k", how="left")
+        assert len(outer) == 3
+        row_a = [r for r in outer.collect() if r["k"] == "a"][0]
+        assert np.isnan(row_a["y"])
+
+    def test_group_by(self):
+        df = self.make()
+        g = df.group_by("b").agg(total=("a", "sum"), n=("a", "count"))
+        rows = {r["b"]: r for r in g.collect()}
+        assert rows["x"]["total"] == 4.0 and rows["x"]["n"] == 2
+
+    def test_random_split(self):
+        df = DataFrame.from_dict({"a": np.arange(1000.0)})
+        tr, te = df.random_split([0.8, 0.2], seed=1)
+        assert len(tr) + len(te) == 1000
+        assert 700 < len(tr) < 900
+
+
+class _AddOne(Transformer):
+    def __init__(self):
+        super().__init__()
+
+    def transform(self, df):
+        return df.with_column("a", df["a"] + 1)
+
+
+class _MeanEstimator(Estimator):
+    def __init__(self):
+        super().__init__()
+
+    def fit(self, df):
+        m = _MeanModel()
+        m.set("mean", float(np.mean(df["a"])))
+        return m
+
+
+class _MeanModel(Model):
+    mean = Param("mean", "the fitted mean", TypeConverters.to_float)
+
+    def __init__(self):
+        super().__init__()
+
+    def transform(self, df):
+        return df.with_column("centered", df["a"] - self.get("mean"))
+
+
+class TestPipeline:
+    def test_fit_transform_chain(self):
+        df = DataFrame.from_dict({"a": [1.0, 2.0, 3.0]})
+        pipe = Pipeline(stages=[_AddOne(), _MeanEstimator()])
+        model = pipe.fit(df)
+        assert isinstance(model, PipelineModel)
+        out = model.transform(df)
+        # AddOne then center by mean of (2,3,4)=3
+        np.testing.assert_allclose(out["centered"], [-1.0, 0.0, 1.0])
+
+    def test_save_load_roundtrip(self, tmp_path):
+        df = DataFrame.from_dict({"a": [1.0, 2.0, 3.0]})
+        model = Pipeline(stages=[_AddOne(), _MeanEstimator()]).fit(df)
+        p = str(tmp_path / "pm")
+        model.save(p)
+        loaded = PipelineModel.load(p)
+        out1 = model.transform(df)
+        out2 = loaded.transform(df)
+        np.testing.assert_allclose(out1["centered"], out2["centered"])
+
+
+class TestSchema:
+    def test_categorical_map(self):
+        cmap = S.CategoricalMap(["lo", "mid", "hi"], ordinal=True)
+        assert cmap.get_index("mid") == 1
+        assert cmap.get_level(2) == "hi"
+        df = DataFrame.from_dict({"c": ["lo", "hi"]})
+        df = S.set_categorical_map(df, "c", cmap)
+        back = S.get_categorical_map(df, "c")
+        assert back.levels == ["lo", "mid", "hi"] and back.ordinal
+
+    def test_image_row(self):
+        img = S.make_image_row(np.zeros((4, 6, 3), dtype=np.uint8), path="p.png")
+        assert img["height"] == 4 and img["width"] == 6 and img["nChannels"] == 3
+        df = DataFrame.from_dict({"image": [img, img]})
+        assert S.is_image(df, "image")
+
+    def test_find_unused_column_name(self):
+        df = DataFrame.from_dict({"x": [1], "x_1": [2]})
+        assert S.find_unused_column_name("x", df) == "x_2"
+
+
+class TestSerializeDataFrame:
+    def test_roundtrip(self, tmp_path):
+        df = DataFrame.from_dict(
+            {"a": [1.0, 2.0], "s": ["p", "q"], "v": np.ones((2, 3))},
+            num_partitions=3,
+        )
+        p = str(tmp_path / "df")
+        serialize.save_dataframe(df, p)
+        back = serialize.load_dataframe(p)
+        assert back.num_partitions == 3
+        np.testing.assert_array_equal(back["a"], df["a"])
+        assert list(back["s"]) == ["p", "q"]
+        np.testing.assert_array_equal(back["v"], df["v"])
+        assert back.dtype("v") == DataType.VECTOR
