@@ -372,8 +372,9 @@ class DataFrame:
         for n in names:
             col = self.column(n)
             v = col.values
-            if col.dtype in (DataType.DOUBLE, DataType.FLOAT):
-                mask &= ~np.isnan(v.astype(np.float64) if v.ndim == 1 else v.astype(np.float64).sum(axis=1))
+            if v.dtype != object and v.dtype.kind == "f":
+                fv = v.astype(np.float64)
+                mask &= ~np.isnan(fv if fv.ndim == 1 else fv.sum(axis=1))
             elif v.dtype == object:
                 mask &= np.array([x is not None for x in v])
         return self.filter(mask)
@@ -460,10 +461,7 @@ class DataFrame:
 
     def map_partitions(self, fn: Callable[["DataFrame"], "DataFrame"]) -> "DataFrame":
         parts = [fn(p) for p in self.partitions()]
-        out = parts[0]
-        for p in parts[1:]:
-            out = out.union(p)
-        return out.repartition(self.num_partitions)
+        return concat(parts).repartition(self.num_partitions)
 
     # -- materialization -------------------------------------------------------
 
@@ -497,6 +495,27 @@ class DataFrame:
             print(row)
 
 
+def concat(frames: Sequence["DataFrame"]) -> "DataFrame":
+    """Row-concatenate DataFrames with identical columns; each column is
+    concatenated once (O(total) copying, unlike pairwise union)."""
+    frames = [f for f in frames if len(f.columns)]
+    if not frames:
+        return DataFrame({})
+    names = frames[0].columns
+    for f in frames[1:]:
+        if set(f.columns) != set(names):
+            raise ValueError(f"concat column mismatch: {names} vs {f.columns}")
+    cols = {}
+    for n in names:
+        first = frames[0].column(n)
+        cols[n] = Column(
+            np.concatenate([f.column(n).values for f in frames]),
+            first.dtype,
+            dict(first.metadata),
+        )
+    return DataFrame(cols, frames[0].num_partitions)
+
+
 def _gather_with_null(col: Column, idx: np.ndarray) -> Column:
     """Gather rows by index; index -1 produces a null (NaN / None / 0)."""
     has_null = (idx < 0).any()
@@ -510,6 +529,14 @@ def _gather_with_null(col: Column, idx: np.ndarray) -> Column:
         elif vals.dtype.kind == "f" or col.dtype == DataType.VECTOR:
             vals = vals.astype(np.float64, copy=True)
             vals[nulls] = np.nan
+        elif vals.dtype.kind in "USM":
+            # Fixed-width strings and timestamps can't hold NaN; widen to
+            # object with None so no silent corruption (timestamps would
+            # otherwise become raw-tick doubles).
+            out = np.empty(len(vals), dtype=object)
+            for i, v in enumerate(vals):
+                out[i] = None if nulls[i] else (v.item() if isinstance(v, np.generic) else v)
+            return Column(out, col.dtype, dict(col.metadata))
         else:
             vals = vals.astype(np.float64)
             vals[nulls] = np.nan

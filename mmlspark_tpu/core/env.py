@@ -13,7 +13,7 @@ TPU-native re-design of the reference's core/env:
 from __future__ import annotations
 
 import os
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -71,17 +71,29 @@ def initialize_distributed(
 def make_mesh(
     shape: Optional[Sequence[int]] = None,
     axis_names: Sequence[str] = ("data",),
+    devices: Optional[Sequence] = None,
 ):
-    """Build a `jax.sharding.Mesh` over all devices. Default: 1-D data mesh
+    """Build a `jax.sharding.Mesh`. Default: 1-D data mesh over all devices
     (the reference's scope — SURVEY.md §2.7 item 6: its distributed axes are
-    rows and models). parallel/mesh.py builds richer dp/tp/sp meshes."""
+    rows and models). parallel/mesh.py builds richer dp/tp/sp meshes.
+
+    `prod(shape)` must equal the number of devices used: pass `devices`
+    explicitly to use a subset — silent truncation is a wrong-mesh bug."""
     import jax
     from jax.sharding import Mesh
 
-    devices = jax.devices()
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
     if shape is None:
         shape = (len(devices),)
-    arr = np.asarray(devices[: int(np.prod(shape))]).reshape(shape)
+    n = int(np.prod(shape))
+    if n != len(devices):
+        raise ValueError(
+            f"Mesh shape {tuple(shape)} needs {n} devices but {len(devices)} "
+            "were given; pass an explicit devices= subset to use fewer"
+        )
+    arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, tuple(axis_names))
 
 
@@ -95,17 +107,3 @@ def cpu_host_devices(n: int = 8) -> None:
             flags + f" --xla_force_host_platform_device_count={n}"
         ).strip()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
-
-class ProcessUtils:
-    """Subprocess exec helper (reference: ProcessUtilities.scala:9-24). The
-    TPU framework needs no mpirun/ssh orchestration; retained for tooling."""
-
-    @staticmethod
-    def run(cmd, timeout: Optional[float] = None) -> Tuple[int, str, str]:
-        import subprocess
-
-        proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout, check=False
-        )
-        return proc.returncode, proc.stdout, proc.stderr

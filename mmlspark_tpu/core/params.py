@@ -85,6 +85,25 @@ class TypeConverters:
         return value
 
 
+def _json_ok(value: Any) -> bool:
+    try:
+        json.dumps(value)
+        return True
+    except TypeError:
+        return False
+
+
+def check_json_simple(owner: str, name: str, value: Any) -> None:
+    """Shared validation for simple (non-complex) param values: must be
+    JSON-serializable or declared as ComplexParam. Used by persistence for
+    both the set and default param maps so the rule can't drift."""
+    if not _json_ok(value):
+        raise TypeError(
+            f"Non-JSON-serializable simple param {name!r} on {owner}; "
+            "declare it as ComplexParam"
+        )
+
+
 class Param:
     """A named, documented, typed parameter declared on a `Params` class.
 
@@ -257,12 +276,19 @@ class Params:
         return merged
 
     def _simple_params_json(self) -> str:
-        """JSON of all set non-complex params (for persistence metadata)."""
+        """JSON of all set non-complex params (for persistence metadata).
+
+        Fails loudly on non-JSON-serializable values: such params must be
+        declared ComplexParam so persistence routes them through the
+        type-dispatched complex writers instead of silently stringifying.
+        """
         out = {}
         for param, value in self._param_map.items():
             if not param.is_complex:
                 out[param.name] = value
-        return json.dumps(out, sort_keys=True, default=str)
+        for name, v in out.items():
+            check_json_simple(type(self).__name__, name, v)
+        return json.dumps(out, sort_keys=True)
 
     def _complex_params(self) -> Iterator[Tuple[Param, Any]]:
         for param, value in self._param_map.items():

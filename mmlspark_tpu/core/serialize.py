@@ -13,11 +13,17 @@ ConstructorWriter.scala). Layout per stage directory:
 
 Class resolution happens through an import-based registry — the analog of the
 reference's classpath scan (JarLoadingUtils.scala:18-148).
+
+Trust boundary: complex params and object columns fall back to pickle, so a
+saved stage directory carries pickle semantics — loading one from an
+untrusted source can execute arbitrary code. Treat stage directories like
+model checkpoints: trusted input only.
 """
 
 from __future__ import annotations
 
 import importlib
+import inspect
 import json
 import os
 import pickle
@@ -27,7 +33,7 @@ from typing import Any, Dict
 import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame, DataType
-from mmlspark_tpu.core.params import Params
+from mmlspark_tpu.core.params import Params, check_json_simple
 
 _FORMAT_VERSION = 1
 
@@ -52,21 +58,60 @@ def _resolve_class(path: str):
 
 
 def save_stage(stage: Params, path: str, overwrite: bool = False) -> None:
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(f"{path} exists; pass overwrite=True")
+    # Write the whole save into a sibling temp dir first, then swap it in, so
+    # a mid-save failure (e.g. a non-serializable param) never destroys a
+    # previous good save at `path`.
+    tmp = path.rstrip("/\\") + ".tmp_save"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        _write_stage(stage, tmp)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
     if os.path.exists(path):
-        if not overwrite:
-            raise FileExistsError(f"{path} exists; pass overwrite=True")
         shutil.rmtree(path)
-    os.makedirs(path)
+    os.replace(tmp, path)
+
+
+def _write_stage(stage: Params, path: str) -> None:
     meta: Dict[str, Any] = {
         "class": _class_path(stage),
         "version": _FORMAT_VERSION,
         "params": json.loads(stage._simple_params_json()),
+        "default_params": {},
         "complex": {},
+        "complex_defaults": {},
+        "init_args": {},
     }
     complex_dir = os.path.join(path, "complex")
+    # Persist the default param map too (reference serializes defaultParamMap:
+    # ComplexParamsSerializer semantics) so stages whose __init__ takes
+    # required args still round-trip their defaults.
+    for param, value in stage._default_param_map.items():
+        if param.is_complex:
+            os.makedirs(complex_dir, exist_ok=True)
+            meta["complex_defaults"][param.name] = _save_complex(
+                value, complex_dir, f"_default_{param.name}"
+            )
+        else:
+            check_json_simple(type(stage).__name__, param.name, value)
+            meta["default_params"][param.name] = value
     for param, value in stage._complex_params():
         os.makedirs(complex_dir, exist_ok=True)
         meta["complex"][param.name] = _save_complex(value, complex_dir, param.name)
+    # ConstructorWritable equivalent (reference: ConstructorWriter.scala —
+    # objectsToSave): a stage whose __init__ takes required args declares
+    # `_init_args() -> dict` naming them; they are saved through the complex
+    # dispatch and fed back to __init__ on load, so instance state built in
+    # __init__ is fully reconstructed.
+    if hasattr(stage, "_init_args"):
+        for name, value in stage._init_args().items():
+            os.makedirs(complex_dir, exist_ok=True)
+            meta["init_args"][name] = _save_complex(value, complex_dir, f"_init_{name}")
     with open(os.path.join(path, "metadata.json"), "w") as f:
         json.dump(meta, f, indent=1, sort_keys=True)
 
@@ -77,17 +122,42 @@ def load_stage(path: str) -> Params:
     cls = _resolve_class(meta["class"])
     stage = cls.__new__(cls)
     Params.__init__(stage)
-    # re-run subclass __init__ default wiring if it is argument-free
-    try:
+    complex_dir = os.path.join(path, "complex")
+    init_kinds = meta.get("init_args", {})
+    if init_kinds:
+        # ConstructorWritable path: re-run __init__ with the persisted args so
+        # non-param instance state is rebuilt exactly as at save time.
+        kwargs = {
+            name: _load_complex(kind, complex_dir, f"_init_{name}")
+            for name, kind in init_kinds.items()
+        }
+        cls.__init__(stage, **kwargs)
+    elif _init_is_arg_free(cls):
         cls.__init__(stage)
-    except TypeError:
-        pass
+    # Stages with required __init__ args and no _init_args() protocol only
+    # round-trip param state; non-param attributes set in __init__ are lost.
+    for name, value in meta.get("default_params", {}).items():
+        stage._set_default(name, value)
+    for name, kind in meta.get("complex_defaults", {}).items():
+        stage._set_default(name, _load_complex(kind, complex_dir, f"_default_{name}"))
     for name, value in meta["params"].items():
         stage.set(name, value)
-    complex_dir = os.path.join(path, "complex")
     for name, kind in meta.get("complex", {}).items():
         stage.set(name, _load_complex(kind, complex_dir, name))
     return stage
+
+
+def _init_is_arg_free(cls) -> bool:
+    try:
+        sig = inspect.signature(cls.__init__)
+    except (TypeError, ValueError):
+        return False
+    for p in list(sig.parameters.values())[1:]:  # skip self
+        if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+            continue
+        if p.default is p.empty:
+            return False
+    return True
 
 
 # -- complex value dispatch ---------------------------------------------------

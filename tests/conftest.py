@@ -15,6 +15,13 @@ if "xla_force_host_platform_device_count" not in flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import jax  # noqa: E402
+
+# A sitecustomize may re-register a hardware backend and force
+# jax_platforms="axon,cpu"; tests must run on the 8 virtual CPU devices, so
+# re-pin the platform list after import (before any backend initializes).
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

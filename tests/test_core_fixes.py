@@ -1,0 +1,143 @@
+"""Targeted regression tests for round-1 advisor/verdict findings
+(ADVICE.md items 1-4; VERDICT.md weak spots 4, 5, 10)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import serialize
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType, concat
+from mmlspark_tpu.core.params import ComplexParam, Param, Params, TypeConverters
+from mmlspark_tpu.core.pipeline import Transformer
+
+
+class _RequiredArgStage(Transformer):
+    alpha = Param("alpha", "a float", TypeConverters.to_float)
+    blob = ComplexParam("blob", "an array")
+
+    def __init__(self, required_thing):
+        super().__init__()
+        self.required_thing = required_thing
+        self._set_defaults(alpha=0.5)
+
+    def _init_args(self):
+        # ConstructorWritable protocol (reference: ConstructorWriter.scala)
+        return {"required_thing": self.required_thing}
+
+    def transform(self, df):
+        return df
+
+
+class _AnyParamStage(Transformer):
+    p = Param("p", "anything", TypeConverters.identity)
+
+    def transform(self, df):
+        return df
+
+
+class _NoProtocolStage(Transformer):
+    alpha = Param("alpha", "a float", TypeConverters.to_float)
+
+    def __init__(self, required_thing):
+        super().__init__()
+        self.required_thing = required_thing
+        self._set_defaults(alpha=0.25)
+
+    def transform(self, df):
+        return df
+
+
+def test_drop_na_vector_rows():
+    df = DataFrame.from_dict({"v": [[1.0, 2.0], [np.nan, 3.0], [4.0, 5.0]]})
+    assert df.dtype("v") == DataType.VECTOR
+    out = df.drop_na()
+    assert len(out) == 2
+    np.testing.assert_allclose(out["v"], [[1.0, 2.0], [4.0, 5.0]])
+
+
+def test_outer_join_string_column_nulls():
+    left = DataFrame.from_dict({"k": np.array([1, 2]), "s": np.array(["a", "b"])})
+    right = DataFrame.from_dict({"k": np.array([2, 3]), "t": np.array(["x", "y"])})
+    out = left.join(right, on="k", how="outer")
+    rows = {r["k"]: r for r in out.collect()}
+    assert rows[1]["t"] is None
+    assert rows[3]["s"] is None
+    assert rows[2]["t"] == "x"
+
+
+def test_outer_join_int_column_becomes_nan_not_garbage():
+    left = DataFrame.from_dict({"k": [1, 2], "x": np.array([10, 20], dtype=np.int64)})
+    right = DataFrame.from_dict({"k": [2, 3], "y": np.array([7, 8], dtype=np.int64)})
+    out = left.join(right, on="k", how="outer")
+    rows = {r["k"]: r for r in out.collect()}
+    assert np.isnan(rows[1]["y"])
+    assert rows[2]["y"] == 7
+
+
+def test_concat_linear_and_typed():
+    frames = [DataFrame.from_dict({"a": [i, i + 1]}) for i in range(5)]
+    out = concat(frames)
+    assert len(out) == 10
+    assert out["a"][0] == 0 and out["a"][-1] == 5
+
+
+def test_map_partitions_preserves_rows():
+    df = DataFrame.from_dict({"a": list(range(100))}, num_partitions=7)
+    out = df.map_partitions(lambda p: p)
+    assert len(out) == 100
+    np.testing.assert_array_equal(out["a"], np.arange(100))
+
+
+def test_serialize_constructor_writable_roundtrip(tmp_path):
+    stage = _RequiredArgStage(required_thing="hello")
+    stage.set("blob", np.arange(3))
+    path = str(tmp_path / "stage")
+    stage.save(path)
+    loaded = serialize.load_stage(path)
+    # __init__ re-ran with the persisted constructor args
+    assert loaded.required_thing == "hello"
+    assert loaded.get("alpha") == 0.5
+    np.testing.assert_array_equal(loaded.get("blob"), np.arange(3))
+
+
+def test_serialize_restores_defaults_without_protocol(tmp_path):
+    stage = _NoProtocolStage(required_thing="x")
+    path = str(tmp_path / "stage")
+    stage.save(path)
+    loaded = serialize.load_stage(path)
+    # __init__ could not re-run (required arg, no protocol) but the default
+    # param map survived via metadata.
+    assert loaded.get("alpha") == 0.25
+
+
+def test_failed_save_preserves_previous_good_save(tmp_path):
+    path = str(tmp_path / "s")
+    good = _AnyParamStage().set("p", 1)
+    good.save(path)
+    bad = _AnyParamStage().set("p", object())
+    with pytest.raises(TypeError):
+        bad.save(path, overwrite=True)
+    loaded = serialize.load_stage(path)  # old save intact
+    assert loaded.get("p") == 1
+
+
+def test_simple_param_non_json_fails_loudly(tmp_path):
+    s = _AnyParamStage()
+    s.set("p", object())
+    with pytest.raises(TypeError, match="ComplexParam"):
+        s.save(str(tmp_path / "s"))
+
+
+def test_make_mesh_rejects_mismatched_shape():
+    from mmlspark_tpu.core.env import make_mesh
+
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh(shape=(3,))  # 8 virtual devices in tests
+
+
+def test_make_mesh_explicit_devices_subset():
+    import jax
+
+    from mmlspark_tpu.core.env import make_mesh
+
+    mesh = make_mesh(shape=(4,), devices=jax.devices()[:4])
+    assert mesh.devices.shape == (4,)
