@@ -350,11 +350,7 @@ class DataFrame:
     def union(self, other: "DataFrame") -> "DataFrame":
         if set(self.columns) != set(other.columns):
             raise ValueError(f"union column mismatch: {self.columns} vs {other.columns}")
-        cols = {}
-        for n, c in self._columns.items():
-            oc = other.column(n)
-            cols[n] = Column(np.concatenate([c.values, oc.values]), c.dtype, dict(c.metadata))
-        return DataFrame(cols, self.num_partitions)
+        return concat([self, other])
 
     def distinct(self) -> "DataFrame":
         keys = list(zip(*(self._hashable_col(n) for n in self.columns))) if self.columns else []
@@ -374,9 +370,12 @@ class DataFrame:
             v = col.values
             if v.dtype != object and v.dtype.kind == "f":
                 fv = v.astype(np.float64)
-                mask &= ~np.isnan(fv if fv.ndim == 1 else fv.sum(axis=1))
+                mask &= ~(np.isnan(fv) if fv.ndim == 1 else np.isnan(fv).any(axis=1))
             elif v.dtype == object:
-                mask &= np.array([x is not None for x in v])
+                # object-backed numeric columns can carry float('nan') values
+                mask &= np.array(
+                    [x is not None and not (isinstance(x, float) and np.isnan(x)) for x in v]
+                )
         return self.filter(mask)
 
     def _hashable_col(self, name: str) -> list:

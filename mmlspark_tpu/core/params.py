@@ -89,7 +89,7 @@ def _json_ok(value: Any) -> bool:
     try:
         json.dumps(value)
         return True
-    except TypeError:
+    except (TypeError, ValueError):  # ValueError: circular containers
         return False
 
 

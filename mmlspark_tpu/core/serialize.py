@@ -28,6 +28,7 @@ import json
 import os
 import pickle
 import shutil
+import tempfile
 from typing import Any, Dict
 
 import numpy as np
@@ -63,10 +64,11 @@ def save_stage(stage: Params, path: str, overwrite: bool = False) -> None:
     # Write the whole save into a sibling temp dir first, then swap it in, so
     # a mid-save failure (e.g. a non-serializable param) never destroys a
     # previous good save at `path`.
-    tmp = path.rstrip("/\\") + ".tmp_save"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    # Unique temp dir so concurrent saves to the same path can't corrupt each
+    # other mid-write; the final os.replace is the only shared step.
+    tmp = tempfile.mkdtemp(prefix=os.path.basename(path) + ".tmp_save_", dir=parent)
     try:
         _write_stage(stage, tmp)
     except BaseException:

@@ -1,0 +1,15 @@
+"""dnn — spec-driven functional neural networks for TPU.
+
+Replaces the reference's CNTK backend (SURVEY.md §2.2 cntk-model/cntk-train):
+the protobuf BrainScript graph becomes a JSON layer spec, the JNI eval
+becomes a jit-compiled pure function, and `layerNames`-style truncation
+(ImageFeaturizer.scala:129-177 `cutOutputLayers`) becomes `Network.truncate`.
+
+Everything is MXU-shaped: NHWC convs via lax.conv_general_dilated, matmuls in
+a configurable compute dtype (bfloat16 on TPU), static shapes throughout.
+"""
+
+from mmlspark_tpu.dnn.network import LAYER_KINDS, Network, layer
+from mmlspark_tpu.dnn.resnet import mlp, resnet20_cifar, resnet_mini
+
+__all__ = ["LAYER_KINDS", "Network", "layer", "mlp", "resnet20_cifar", "resnet_mini"]

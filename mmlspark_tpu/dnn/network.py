@@ -1,0 +1,545 @@
+"""Network: a named-layer functional NN built from a JSON-serializable spec.
+
+The spec is the model DSL — the role BrainScript plays in the reference
+(BrainscriptBuilder.scala:16-151) — but declarative JSON that rebuilds the
+same jax function anywhere. Named layers give the `layerNames` metadata the
+reference's model zoo schema carries (downloader Schema.scala), so
+ImageFeaturizer-style truncation works by name or by count.
+
+Variables are split into two collections:
+    {"params": {layer: {...trainable...}}, "state": {layer: {...running stats}}}
+so trainers differentiate w.r.t. params only (BatchNorm running mean/var live
+in state). All layer applies are pure; train-mode BatchNorm returns updated
+state through `apply_and_state`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+Spec = List[Dict[str, Any]]
+
+LAYER_KINDS: Dict[str, "LayerDef"] = {}
+
+
+class LayerDef:
+    def __init__(self, kind: str, init: Callable, apply: Callable):
+        self.kind = kind
+        self.init = init      # (rng, cfg, in_shape) -> (params, state, out_shape)
+        self.apply = apply    # (params, state, cfg, x, train, rng) -> (y, new_state)
+
+
+def layer(kind: str):
+    """Register a layer kind: decorated fn returns (init, apply)."""
+
+    def wrap(fn):
+        init, apply = fn()
+        LAYER_KINDS[kind] = LayerDef(kind, init, apply)
+        return fn
+
+    return wrap
+
+
+def _he_normal(rng, shape, fan_in, dtype):
+    import jax
+
+    std = np.sqrt(2.0 / max(1, fan_in))
+    return (jax.random.normal(rng, shape) * std).astype(dtype)
+
+
+# -- layer kinds ---------------------------------------------------------------
+
+
+@layer("dense")
+def _dense():
+    def init(rng, cfg, in_shape):
+        d_in = int(np.prod(in_shape))
+        d_out = cfg["units"]
+        params = {
+            "kernel": _he_normal(rng, (d_in, d_out), d_in, np.float32),
+            "bias": np.zeros((d_out,), np.float32),
+        }
+        return params, {}, (d_out,)
+
+    def apply(params, state, cfg, x, train, rng):
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        return x @ params["kernel"] + params["bias"], state
+
+    return init, apply
+
+
+@layer("conv")
+def _conv():
+    def init(rng, cfg, in_shape):
+        kh = kw = cfg.get("kernel", 3)
+        if isinstance(kh, (list, tuple)):
+            kh, kw = kh
+        c_in = in_shape[-1]
+        c_out = cfg["filters"]
+        stride = cfg.get("stride", 1)
+        params = {
+            "kernel": _he_normal(rng, (kh, kw, c_in, c_out), kh * kw * c_in, np.float32),
+        }
+        if cfg.get("use_bias", True):
+            params["bias"] = np.zeros((c_out,), np.float32)
+        h, w = in_shape[0], in_shape[1]
+        if cfg.get("padding", "SAME") == "SAME":
+            oh, ow = -(-h // stride), -(-w // stride)
+        else:
+            oh, ow = (h - kh) // stride + 1, (w - kw) // stride + 1
+        return params, {}, (oh, ow, c_out)
+
+    def apply(params, state, cfg, x, train, rng):
+        import jax
+
+        stride = cfg.get("stride", 1)
+        y = jax.lax.conv_general_dilated(
+            x,
+            params["kernel"].astype(x.dtype),
+            window_strides=(stride, stride),
+            padding=cfg.get("padding", "SAME"),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if "bias" in params:
+            y = y + params["bias"].astype(y.dtype)
+        return y, state
+
+    return init, apply
+
+
+@layer("batchnorm")
+def _batchnorm():
+    def init(rng, cfg, in_shape):
+        c = in_shape[-1]
+        params = {"scale": np.ones((c,), np.float32), "bias": np.zeros((c,), np.float32)}
+        state = {"mean": np.zeros((c,), np.float32), "var": np.ones((c,), np.float32)}
+        return params, state, in_shape
+
+    def apply(params, state, cfg, x, train, rng):
+        import jax.numpy as jnp
+
+        eps = cfg.get("epsilon", 1e-5)
+        momentum = cfg.get("momentum", 0.9)
+        if train:
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            new_state = {
+                "mean": momentum * state["mean"] + (1 - momentum) * mean,
+                "var": momentum * state["var"] + (1 - momentum) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = (params["scale"] / jnp.sqrt(var + eps)).astype(x.dtype)
+        y = (x - mean.astype(x.dtype)) * inv + params["bias"].astype(x.dtype)
+        return y, new_state
+
+    return init, apply
+
+
+def _stateless(fn, shape_fn=None):
+    def init(rng, cfg, in_shape):
+        out = shape_fn(cfg, in_shape) if shape_fn else in_shape
+        return {}, {}, out
+
+    def apply(params, state, cfg, x, train, rng):
+        return fn(cfg, x), state
+
+    return init, apply
+
+
+@layer("relu")
+def _relu():
+    import_fn = lambda cfg, x: __import__("jax.numpy", fromlist=["maximum"]).maximum(x, 0)
+    return _stateless(import_fn)
+
+
+@layer("gelu")
+def _gelu():
+    def fn(cfg, x):
+        import jax
+
+        return jax.nn.gelu(x)
+
+    return _stateless(fn)
+
+
+@layer("tanh")
+def _tanh():
+    def fn(cfg, x):
+        import jax.numpy as jnp
+
+        return jnp.tanh(x)
+
+    return _stateless(fn)
+
+
+@layer("sigmoid")
+def _sigmoid():
+    def fn(cfg, x):
+        import jax
+
+        return jax.nn.sigmoid(x)
+
+    return _stateless(fn)
+
+
+@layer("softmax")
+def _softmax():
+    def fn(cfg, x):
+        import jax
+
+        return jax.nn.softmax(x, axis=-1)
+
+    return _stateless(fn)
+
+
+@layer("log_softmax")
+def _log_softmax():
+    def fn(cfg, x):
+        import jax
+
+        return jax.nn.log_softmax(x, axis=-1)
+
+    return _stateless(fn)
+
+
+def _pool_shape(cfg, in_shape):
+    k = cfg.get("size", 2)
+    s = cfg.get("stride", k)
+    h, w, c = in_shape
+    return ((h - k) // s + 1, (w - k) // s + 1, c)
+
+
+@layer("max_pool")
+def _max_pool():
+    def fn(cfg, x):
+        import jax
+
+        k = cfg.get("size", 2)
+        s = cfg.get("stride", k)
+        return jax.lax.reduce_window(
+            x, -np.inf, jax.lax.max, (1, k, k, 1), (1, s, s, 1), "VALID"
+        )
+
+    return _stateless(fn, _pool_shape)
+
+
+@layer("avg_pool")
+def _avg_pool():
+    def fn(cfg, x):
+        import jax
+
+        k = cfg.get("size", 2)
+        s = cfg.get("stride", k)
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, (1, k, k, 1), (1, s, s, 1), "VALID"
+        )
+        return summed / (k * k)
+
+    return _stateless(fn, _pool_shape)
+
+
+@layer("global_avg_pool")
+def _global_avg_pool():
+    def fn(cfg, x):
+        import jax.numpy as jnp
+
+        return jnp.mean(x, axis=(1, 2))
+
+    return _stateless(fn, lambda cfg, s: (s[-1],))
+
+
+@layer("flatten")
+def _flatten():
+    def fn(cfg, x):
+        return x.reshape(x.shape[0], -1)
+
+    return _stateless(fn, lambda cfg, s: (int(np.prod(s)),))
+
+
+@layer("dropout")
+def _dropout():
+    def init(rng, cfg, in_shape):
+        return {}, {}, in_shape
+
+    def apply(params, state, cfg, x, train, rng):
+        if not train or rng is None:
+            return x, state
+        import jax
+
+        rate = cfg.get("rate", 0.5)
+        keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+        return (x * keep) / (1.0 - rate), state
+
+    return init, apply
+
+
+@layer("residual")
+def _residual():
+    def init(rng, cfg, in_shape):
+        import jax
+
+        body = cfg["body"]
+        shortcut = cfg.get("shortcut") or []
+        r_body, r_short = jax.random.split(rng)
+        bp, bs, out_shape = _init_spec(r_body, body, in_shape)
+        sp, ss, s_shape = _init_spec(r_short, shortcut, in_shape)
+        if s_shape != out_shape:
+            raise ValueError(
+                f"residual shapes differ: body {out_shape} vs shortcut {s_shape}"
+            )
+        return {"body": bp, "shortcut": sp}, {"body": bs, "shortcut": ss}, out_shape
+
+    def apply(params, state, cfg, x, train, rng):
+        # .get with {} fallbacks: empty subtrees (identity shortcut, no BN
+        # state) are dropped by the flattened npz save and must not be required
+        body = cfg["body"]
+        shortcut = cfg.get("shortcut") or []
+        y, new_bs, _ = _apply_spec(
+            params.get("body", {}), state.get("body", {}), body, x, train, rng, None
+        )
+        s, new_ss, _ = _apply_spec(
+            params.get("shortcut", {}), state.get("shortcut", {}), shortcut,
+            x, train, rng, None,
+        )
+        return y + s, {"body": new_bs, "shortcut": new_ss}
+
+    return init, apply
+
+
+# -- spec walking --------------------------------------------------------------
+
+
+def _named_spec(spec: Spec) -> Spec:
+    """Assign unique names to unnamed layers (kind_index)."""
+    out = []
+    seen = set()
+    for i, cfg in enumerate(spec):
+        cfg = dict(cfg)
+        name = cfg.get("name") or f"{cfg['kind']}_{i}"
+        if name in seen:
+            raise ValueError(f"duplicate layer name {name!r}")
+        seen.add(name)
+        cfg["name"] = name
+        out.append(cfg)
+    return out
+
+
+def _init_spec(rng, spec: Spec, in_shape):
+    import jax
+
+    params, state = {}, {}
+    shape = tuple(in_shape)
+    spec = _named_spec(spec)
+    rngs = jax.random.split(rng, max(1, len(spec)))
+    for cfg, r in zip(spec, rngs):
+        d = LAYER_KINDS[cfg["kind"]]
+        p, s, shape = d.init(r, cfg, shape)
+        if p:
+            params[cfg["name"]] = p
+        if s:
+            state[cfg["name"]] = s
+    return params, state, shape
+
+
+def _apply_spec(params, state, spec: Spec, x, train, rng, capture: Optional[set]):
+    import jax
+
+    new_state = {}
+    acts = {}
+    spec = _named_spec(spec)
+    if rng is not None:
+        rngs = jax.random.split(rng, max(1, len(spec)))
+    else:
+        rngs = [None] * len(spec)
+    for cfg, r in zip(spec, rngs):
+        d = LAYER_KINDS[cfg["kind"]]
+        name = cfg["name"]
+        x, s = d.apply(params.get(name, {}), state.get(name, {}), cfg, x, train, r)
+        if s:
+            new_state[name] = s
+        if capture is not None and name in capture:
+            acts[name] = x
+    return x, new_state, acts
+
+
+class Network:
+    """A named-layer NN: JSON spec + (params, state) variables.
+
+    Usage:
+        net = Network(spec, input_shape=(32, 32, 3))
+        variables = net.init(jax.random.PRNGKey(0))
+        y = net.apply(variables, x)                     # inference
+        y, new_state = net.apply_and_state(variables, x, train=True, rng=r)
+    """
+
+    def __init__(
+        self,
+        spec: Spec,
+        input_shape: Sequence[int],
+        compute_dtype: str = "float32",
+    ):
+        self.spec = _named_spec(spec)
+        self.input_shape = tuple(int(d) for d in input_shape)
+        self.compute_dtype = compute_dtype
+        for cfg in self.spec:
+            if cfg["kind"] not in LAYER_KINDS:
+                raise ValueError(f"unknown layer kind {cfg['kind']!r}")
+
+    # -- structure -------------------------------------------------------------
+
+    @property
+    def layer_names(self) -> List[str]:
+        return [cfg["name"] for cfg in self.spec]
+
+    def out_shape(self) -> Tuple[int, ...]:
+        import jax
+
+        _, _, shape = _init_spec(jax.random.PRNGKey(0), self.spec, self.input_shape)
+        return shape
+
+    def truncate(self, cut_output_layers: int) -> "Network":
+        """Drop the last N layers — the reference's `cutOutputLayers`
+        headless-featurization semantics (ImageFeaturizer.scala:129-177)."""
+        if not 0 <= cut_output_layers < len(self.spec):
+            raise ValueError(
+                f"cut_output_layers={cut_output_layers} out of range for "
+                f"{len(self.spec)} layers"
+            )
+        spec = self.spec[: len(self.spec) - cut_output_layers]
+        return Network(spec, self.input_shape, self.compute_dtype)
+
+    def truncate_at(self, layer_name: str) -> "Network":
+        """Keep layers up to and including `layer_name`."""
+        names = self.layer_names
+        if layer_name not in names:
+            raise ValueError(f"no layer {layer_name!r}; have {names}")
+        idx = names.index(layer_name)
+        return Network(self.spec[: idx + 1], self.input_shape, self.compute_dtype)
+
+    # -- init / apply ----------------------------------------------------------
+
+    def init(self, rng) -> Dict[str, Any]:
+        params, state, _ = _init_spec(rng, self.spec, self.input_shape)
+        return {"params": params, "state": state}
+
+    def _cast_in(self, x):
+        import jax.numpy as jnp
+
+        return x.astype(jnp.dtype(self.compute_dtype))
+
+    def apply(self, variables, x, train: bool = False, rng=None):
+        y, _, _ = _apply_spec(
+            variables["params"], variables["state"], self.spec,
+            self._cast_in(x), train, rng, None,
+        )
+        return y
+
+    def apply_and_state(self, variables, x, train: bool = True, rng=None):
+        y, new_state, _ = _apply_spec(
+            variables["params"], variables["state"], self.spec,
+            self._cast_in(x), train, rng, None,
+        )
+        merged = dict(variables["state"])
+        merged.update(new_state)
+        return y, merged
+
+    def apply_collect(self, variables, x, layer_names: Sequence[str]):
+        """Forward pass capturing named intermediate activations."""
+        y, _, acts = _apply_spec(
+            variables["params"], variables["state"], self.spec,
+            self._cast_in(x), False, None, set(layer_names),
+        )
+        return y, acts
+
+    # -- persistence (serialize.py "custom" protocol) --------------------------
+
+    def save_to_dir(self, path: str, variables: Optional[dict] = None) -> None:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "spec.json"), "w") as f:
+            json.dump(
+                {
+                    "spec": self.spec,
+                    "input_shape": list(self.input_shape),
+                    "compute_dtype": self.compute_dtype,
+                },
+                f,
+                indent=1,
+            )
+        if variables is not None:
+            flat = _flatten_tree(variables)
+            np.savez(os.path.join(path, "variables.npz"), **flat)
+
+    @classmethod
+    def load_from_dir(cls, path: str) -> "Network":
+        with open(os.path.join(path, "spec.json")) as f:
+            meta = json.load(f)
+        return cls(meta["spec"], meta["input_shape"], meta["compute_dtype"])
+
+    @staticmethod
+    def load_variables(path: str) -> Optional[dict]:
+        vpath = os.path.join(path, "variables.npz")
+        if not os.path.exists(vpath):
+            return None
+        with np.load(vpath) as z:
+            tree = _unflatten_tree({k: z[k] for k in z.files})
+        tree.setdefault("params", {})
+        tree.setdefault("state", {})
+        return tree
+
+
+class NetworkBundle:
+    """A Network together with its trained variables — the unit a model
+    stage holds and persists (the reference's serialized CNTK model bytes,
+    SerializableFunction.scala:88-115, reborn as spec JSON + weights npz)."""
+
+    def __init__(self, network: Network, variables: dict):
+        self.network = network
+        self.variables = variables
+
+    def save_to_dir(self, path: str) -> None:
+        self.network.save_to_dir(path, self.variables)
+
+    @classmethod
+    def load_from_dir(cls, path: str) -> "NetworkBundle":
+        network = Network.load_from_dir(path)
+        variables = Network.load_variables(path)
+        if variables is None:
+            raise FileNotFoundError(f"no variables.npz under {path}")
+        variables.setdefault("params", {})
+        variables.setdefault("state", {})
+        return cls(network, variables)
+
+
+_SEP = "/"
+
+
+def _flatten_tree(tree: dict, prefix: str = "") -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}{_SEP}{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            if not v:
+                continue
+            out.update(_flatten_tree(v, key))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def _unflatten_tree(flat: Dict[str, np.ndarray]) -> dict:
+    tree: dict = {}
+    for key, v in flat.items():
+        parts = key.split(_SEP)
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree

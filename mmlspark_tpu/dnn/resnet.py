@@ -1,0 +1,111 @@
+"""Model builders: ResNet for CIFAR, small MLPs.
+
+The flagship inference model — the role the CNTK ResNet zoo plays for the
+reference's CIFAR10 notebook (SURVEY.md §7 phase 3; reference model zoo via
+downloader ModelDownloader.scala:209-267). Specs are plain JSON so they
+round-trip through Network.save_to_dir.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from mmlspark_tpu.dnn.network import Network
+
+
+def _bn_relu_conv(filters: int, stride: int = 1, kernel: int = 3) -> List[dict]:
+    return [
+        {"kind": "conv", "filters": filters, "kernel": kernel, "stride": stride,
+         "use_bias": False},
+        {"kind": "batchnorm"},
+        {"kind": "relu"},
+    ]
+
+
+def _basic_block(filters: int, stride: int = 1, project: bool = False) -> dict:
+    body = [
+        {"kind": "conv", "filters": filters, "kernel": 3, "stride": stride,
+         "use_bias": False},
+        {"kind": "batchnorm"},
+        {"kind": "relu"},
+        {"kind": "conv", "filters": filters, "kernel": 3, "stride": 1,
+         "use_bias": False},
+        {"kind": "batchnorm"},
+    ]
+    shortcut = None
+    if project:
+        shortcut = [
+            {"kind": "conv", "filters": filters, "kernel": 1, "stride": stride,
+             "use_bias": False},
+            {"kind": "batchnorm"},
+        ]
+    block: dict = {"kind": "residual", "body": body}
+    if shortcut:
+        block["shortcut"] = shortcut
+    return block
+
+
+def resnet_cifar(
+    depth: int = 20,
+    num_classes: int = 10,
+    input_shape: Sequence[int] = (32, 32, 3),
+    compute_dtype: str = "float32",
+) -> Network:
+    """ResNet-(6n+2) for CIFAR (He et al. config): 3 stages of n basic blocks
+    at 16/32/64 filters. depth=20 -> n=3."""
+    if (depth - 2) % 6 != 0:
+        raise ValueError("CIFAR ResNet depth must be 6n+2")
+    n = (depth - 2) // 6
+    spec: List[dict] = [
+        {"kind": "conv", "name": "stem", "filters": 16, "kernel": 3, "use_bias": False},
+        {"kind": "batchnorm", "name": "stem_bn"},
+        {"kind": "relu", "name": "stem_relu"},
+    ]
+    for stage, filters in enumerate((16, 32, 64)):
+        for block in range(n):
+            stride = 2 if (stage > 0 and block == 0) else 1
+            project = stage > 0 and block == 0
+            cfg = _basic_block(filters, stride, project)
+            cfg["name"] = f"stage{stage + 1}_block{block + 1}"
+            spec.append(cfg)
+            spec.append({"kind": "relu", "name": f"stage{stage + 1}_relu{block + 1}"})
+    spec += [
+        {"kind": "global_avg_pool", "name": "pool"},
+        {"kind": "dense", "name": "logits", "units": num_classes},
+    ]
+    return Network(spec, input_shape, compute_dtype)
+
+
+def resnet20_cifar(num_classes: int = 10, compute_dtype: str = "float32") -> Network:
+    return resnet_cifar(20, num_classes, compute_dtype=compute_dtype)
+
+
+def resnet_mini(num_classes: int = 10, input_shape: Sequence[int] = (8, 8, 3)) -> Network:
+    """Tiny 2-block ResNet for fast CPU tests."""
+    spec = [
+        {"kind": "conv", "name": "stem", "filters": 8, "kernel": 3, "use_bias": False},
+        {"kind": "batchnorm", "name": "stem_bn"},
+        {"kind": "relu", "name": "stem_relu"},
+        dict(_basic_block(8), name="block1"),
+        {"kind": "relu", "name": "relu1"},
+        {"kind": "global_avg_pool", "name": "pool"},
+        {"kind": "dense", "name": "logits", "units": num_classes},
+    ]
+    return Network(spec, input_shape)
+
+
+def mlp(
+    input_dim: int,
+    hidden: Sequence[int],
+    num_outputs: int,
+    activation: str = "relu",
+    compute_dtype: str = "float32",
+) -> Network:
+    """Dense MLP over VECTOR features — the BrainScript one-liner equivalent
+    (reference cntk-train's default model)."""
+    spec: List[dict] = []
+    for i, h in enumerate(hidden):
+        spec.append({"kind": "dense", "name": f"dense_{i}", "units": int(h)})
+        spec.append({"kind": activation, "name": f"{activation}_{i}"})
+    spec.append({"kind": "dense", "name": "logits", "units": int(num_outputs)})
+    return Network(spec, (input_dim,), compute_dtype)

@@ -1,0 +1,9 @@
+"""Model stages: deep-net inference and featurization on TPU.
+
+Equivalent of the reference's cntk-model and image-featurizer modules
+(SURVEY.md §2.2).
+"""
+
+from mmlspark_tpu.models.tpu_model import TPUModel
+
+__all__ = ["TPUModel"]

@@ -1,0 +1,33 @@
+"""Parallelism: device meshes, shardings, collectives.
+
+TPU-native replacement for the reference's four distributed mechanisms
+(SURVEY.md §2.7 / §5 "Distributed communication backend"):
+
+- LightGBM driver-socket rendezvous + native TCP allreduce
+  (LightGBMUtils.scala:97-137, TrainUtils.scala:217)
+- mpirun/ssh GPU ring for CNTK training (CommandBuilders.scala:105-269)
+- Spark broadcast/shuffle
+- HTTP serving edge
+
+All collapse into `jax.sharding.Mesh` + NamedSharding + XLA collectives
+(psum/all_gather) over ICI, with `jax.distributed.initialize` for multi-host
+DCN rendezvous (core/env.py).
+"""
+
+from mmlspark_tpu.parallel.mesh import (
+    batch_sharding,
+    data_parallel_mesh,
+    make_mesh,
+    pad_to_multiple,
+    replicated_sharding,
+    shard_batch,
+)
+
+__all__ = [
+    "batch_sharding",
+    "data_parallel_mesh",
+    "make_mesh",
+    "pad_to_multiple",
+    "replicated_sharding",
+    "shard_batch",
+]

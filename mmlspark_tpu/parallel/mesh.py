@@ -1,0 +1,75 @@
+"""Mesh construction and sharding helpers.
+
+The reference scales by rows — Spark partitions, 1 executor : 1 device
+(SURVEY.md §2.7 item 1). Here the same axis is a named mesh dimension
+("data"); model/tensor axes are available for wider meshes. XLA inserts the
+collectives; callers only annotate shardings (the scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.env import make_mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def data_parallel_mesh(n_devices: Optional[int] = None):
+    """1-D mesh over all (or the first n) devices with axis name "data"."""
+    import jax
+
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return make_mesh((len(devices),), (DATA_AXIS,), devices)
+
+
+def dp_tp_mesh(dp: int, tp: int, devices: Optional[Sequence] = None):
+    """2-D (data, model) mesh for DP x TP workloads. The model axis should
+    map to the fastest ICI links; JAX device order on TPU already reflects
+    physical topology, so a simple reshape is correct for slices."""
+    return make_mesh((dp, tp), (DATA_AXIS, MODEL_AXIS), devices)
+
+
+def batch_sharding(mesh, ndim: int = 1, axis: int = 0):
+    """NamedSharding placing array dim `axis` on the mesh "data" axis,
+    replicating the rest. The canonical input sharding for DP compute."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = [None] * ndim
+    spec[axis] = DATA_AXIS
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0) -> Tuple[np.ndarray, int]:
+    """Pad `axis` up to a multiple (repeating the last row so padded rows are
+    valid inputs); returns (padded, original_length). Static shapes keep XLA
+    from recompiling per batch and let the batch dim divide the mesh."""
+    n = arr.shape[axis]
+    if n == 0 or n % multiple == 0:
+        return arr, n
+    pad_n = multiple - n % multiple
+    pad_block = np.take(arr, [-1] * pad_n, axis=axis)
+    return np.concatenate([arr, pad_block], axis=axis), n
+
+
+def shard_batch(mesh, arr: np.ndarray):
+    """Host array -> device array sharded along "data". Pads the batch to the
+    data-axis size so every chip gets an equal slice (XLA requirement), and
+    returns (sharded_array, original_length)."""
+    import jax
+
+    n_data = mesh.shape[DATA_AXIS]
+    padded, n = pad_to_multiple(np.asarray(arr), n_data, axis=0)
+    sharding = batch_sharding(mesh, ndim=padded.ndim)
+    return jax.device_put(padded, sharding), n

@@ -1,0 +1,19 @@
+"""Utility pipeline stages (df -> df transformers).
+
+Equivalent of the reference's pipeline-stages module plus the
+MiniBatchTransformer family from io/http (SURVEY.md §2.4).
+"""
+
+from mmlspark_tpu.stages.batching import (
+    DynamicMiniBatchTransformer,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+    TimeIntervalMiniBatchTransformer,
+)
+
+__all__ = [
+    "DynamicMiniBatchTransformer",
+    "FixedMiniBatchTransformer",
+    "FlattenBatch",
+    "TimeIntervalMiniBatchTransformer",
+]

@@ -1,0 +1,162 @@
+"""MiniBatch / FlattenBatch stages.
+
+Reference: io/http/src/main/scala/MiniBatchTransformer.scala:13-203 and
+Batchers.scala:12-152 (Fixed / Dynamic / TimeInterval batchers). A batched
+DataFrame has one row per batch; every column's value is the array of that
+batch's values (VECTOR columns batch to 2-D arrays). FlattenBatch inverts.
+
+In the reference these exist to amortize JNI-call and HTTP-request overhead;
+here they amortize device dispatch — TPUModel consumes whole batches per jit
+call. The eager columnar engine makes Dynamic/TimeInterval degenerate to
+"one batch per partition", which is the same observable semantics their
+streaming versions have under a fully-buffered source.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType, Field
+from mmlspark_tpu.core.params import Param, TypeConverters, Wrappable
+from mmlspark_tpu.core.pipeline import Transformer
+
+
+def _batch_column(col: Column, bounds: List[tuple]) -> Column:
+    out = np.empty(len(bounds), dtype=object)
+    for i, (start, stop) in enumerate(bounds):
+        chunk = col.values[start:stop]
+        out[i] = list(chunk) if chunk.dtype == object else chunk
+    return Column(out, DataType.ARRAY, dict(col.metadata))
+
+
+def _batch_df(df: DataFrame, bounds: List[tuple]) -> DataFrame:
+    return DataFrame(
+        {n: _batch_column(df.column(n), bounds) for n in df.columns},
+        df.num_partitions,
+    )
+
+
+class FixedMiniBatchTransformer(Transformer, Wrappable):
+    """Group rows into fixed-size batches (reference default for CNTKModel:
+    FixedMiniBatchTransformer(10), CNTKModel.scala:376)."""
+
+    batch_size = Param("batch_size", "The max size of the buffer", TypeConverters.to_int)
+
+    def __init__(self, batch_size: int = 10):
+        super().__init__()
+        self.set(self.batch_size, batch_size)
+
+    def set_batch_size(self, value: int):
+        return self.set(self.batch_size, value)
+
+    def get_batch_size(self) -> int:
+        return self.get(self.batch_size)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return [Field(f.name, DataType.ARRAY, dict(f.metadata)) for f in schema]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        bs = self.get(self.batch_size)
+        n = len(df)
+        bounds = [(i, min(i + bs, n)) for i in range(0, n, bs)]
+        return _batch_df(df, bounds)
+
+
+class DynamicMiniBatchTransformer(Transformer, Wrappable):
+    """Batch = whatever is available, capped at max_batch_size. Eagerly that
+    is one batch per partition (capped)."""
+
+    max_batch_size = Param(
+        "max_batch_size", "The max size of the buffer", TypeConverters.to_int
+    )
+
+    def __init__(self, max_batch_size: int = 2 ** 31 - 1):
+        super().__init__()
+        self.set(self.max_batch_size, max_batch_size)
+
+    def set_max_batch_size(self, value: int):
+        return self.set(self.max_batch_size, value)
+
+    def get_max_batch_size(self) -> int:
+        return self.get(self.max_batch_size)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return [Field(f.name, DataType.ARRAY, dict(f.metadata)) for f in schema]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        cap = self.get(self.max_batch_size)
+        bounds = []
+        for start, stop in df.partition_bounds():
+            while stop - start > cap:
+                bounds.append((start, start + cap))
+                start += cap
+            if stop > start:
+                bounds.append((start, stop))
+        return _batch_df(df, bounds)
+
+
+class TimeIntervalMiniBatchTransformer(Transformer, Wrappable):
+    """Batch by wall-clock interval in a streaming engine; over a fully
+    materialized frame every interval's worth of rows is already buffered, so
+    it reduces to DynamicMiniBatch semantics. Params kept for API parity."""
+
+    millis_to_wait = Param(
+        "millis_to_wait", "The time to wait before constructing a batch",
+        TypeConverters.to_int,
+    )
+    max_batch_size = Param(
+        "max_batch_size", "The max size of the buffer", TypeConverters.to_int
+    )
+
+    def __init__(self, millis_to_wait: int = 1000, max_batch_size: int = 2 ** 31 - 1):
+        super().__init__()
+        self.set(self.millis_to_wait, millis_to_wait)
+        self.set(self.max_batch_size, max_batch_size)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return [Field(f.name, DataType.ARRAY, dict(f.metadata)) for f in schema]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return (
+            DynamicMiniBatchTransformer(self.get(self.max_batch_size)).transform(df)
+        )
+
+
+class FlattenBatch(Transformer, Wrappable):
+    """Explode batched rows back into per-element rows (reference:
+    MiniBatchTransformer.scala:173 FlattenBatch)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        # Element types aren't recoverable statically; leave as-is for ARRAY.
+        return schema
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        if len(df) == 0:
+            return df
+        cols = {}
+        counts = None
+        for name in df.columns:
+            col = df.column(name)
+            rows = list(col.values)
+            lens = [len(np.asarray(r)) if not isinstance(r, list) else len(r) for r in rows]
+            if counts is None:
+                counts = lens
+            elif lens != counts:
+                raise ValueError(
+                    f"FlattenBatch: column {name!r} batch sizes {lens[:3]}... "
+                    f"differ from {counts[:3]}..."
+                )
+            if rows and isinstance(rows[0], np.ndarray):
+                flat = np.concatenate(rows) if rows else np.empty(0)
+                cols[name] = Column(flat, None, dict(col.metadata))
+            else:
+                merged: list = []
+                for r in rows:
+                    merged.extend(list(r))
+                cols[name] = Column(merged, None, dict(col.metadata))
+        return DataFrame(cols, df.num_partitions)

@@ -1,0 +1,151 @@
+"""Tests: dnn Network/layers, TPUModel inference, minibatch stages."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.dnn import Network, mlp, resnet_mini
+from mmlspark_tpu.dnn.network import NetworkBundle
+from mmlspark_tpu.models import TPUModel
+from mmlspark_tpu.stages import (
+    DynamicMiniBatchTransformer,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+)
+
+import jax
+
+
+def test_mlp_shapes_and_determinism():
+    net = mlp(4, [8], 3)
+    variables = net.init(jax.random.PRNGKey(0))
+    x = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+    y1 = np.asarray(net.apply(variables, x))
+    y2 = np.asarray(net.apply(variables, x))
+    assert y1.shape == (5, 3)
+    np.testing.assert_array_equal(y1, y2)
+    assert net.out_shape() == (3,)
+
+
+def test_resnet_mini_forward_and_bn_state():
+    net = resnet_mini(num_classes=4)
+    variables = net.init(jax.random.PRNGKey(1))
+    x = np.random.default_rng(1).normal(size=(2, 8, 8, 3)).astype(np.float32)
+    y = np.asarray(net.apply(variables, x))
+    assert y.shape == (2, 4)
+    # train-mode apply returns updated running stats
+    y_t, new_state = net.apply_and_state(variables, x, train=True, rng=jax.random.PRNGKey(2))
+    assert "stem_bn" in new_state
+    assert not np.allclose(new_state["stem_bn"]["mean"], variables["state"]["stem_bn"]["mean"])
+
+
+def test_network_truncate_and_collect():
+    net = mlp(4, [8, 6], 2)
+    variables = net.init(jax.random.PRNGKey(0))
+    x = np.ones((3, 4), np.float32)
+    head = net.truncate_at("dense_1")
+    h = np.asarray(head.apply(variables, x))
+    assert h.shape == (3, 6)
+    _, acts = net.apply_collect(variables, x, ["dense_1"])
+    np.testing.assert_allclose(np.asarray(acts["dense_1"]), h, rtol=1e-6)
+    # truncate by count: dropping the final dense leaves the relu_1 output
+    assert net.truncate(1).layer_names[-1] == "relu_1"
+    with pytest.raises(ValueError):
+        net.truncate(99)
+
+
+def test_network_save_load_roundtrip(tmp_path):
+    net = mlp(3, [5], 2)
+    variables = net.init(jax.random.PRNGKey(0))
+    path = str(tmp_path / "net")
+    net.save_to_dir(path, variables)
+    net2 = Network.load_from_dir(path)
+    v2 = Network.load_variables(path)
+    x = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(net.apply(variables, x)), np.asarray(net2.apply(v2, x)), rtol=1e-6
+    )
+    assert net2.layer_names == net.layer_names
+
+
+def test_tpu_model_transform_and_persistence(tmp_path):
+    net = mlp(4, [8], 3)
+    variables = net.init(jax.random.PRNGKey(0))
+    bundle = NetworkBundle(net, variables)
+    model = TPUModel(bundle, input_col="feats", output_col="scores", mini_batch_size=4)
+    x = np.random.default_rng(2).normal(size=(10, 4))
+    df = DataFrame.from_dict({"feats": x, "id": np.arange(10)})
+    out = model.transform(df)
+    assert out.dtype("scores") == DataType.VECTOR
+    assert out["scores"].shape == (10, 3)
+    expected = np.asarray(net.apply(variables, x.astype(np.float32)))
+    np.testing.assert_allclose(out["scores"], expected, rtol=1e-5)
+
+    # odd batch sizes pad correctly (batch 4 over 10 rows)
+    model2 = TPUModel(bundle, "feats", "scores", mini_batch_size=3)
+    np.testing.assert_allclose(model2.transform(df)["scores"], expected, rtol=1e-5)
+
+    # persistence round-trip through stage save/load
+    path = str(tmp_path / "tpu_model")
+    model.save(path)
+    loaded = TPUModel.load(path)
+    np.testing.assert_allclose(loaded.transform(df)["scores"], expected, rtol=1e-5)
+
+
+def test_tpu_model_output_layer_featurization():
+    net = mlp(4, [8], 3)
+    variables = net.init(jax.random.PRNGKey(0))
+    model = TPUModel(NetworkBundle(net, variables), "feats", "emb")
+    model.set_output_layer("relu_0")
+    df = DataFrame.from_dict({"feats": np.ones((5, 4))})
+    out = model.transform(df)
+    assert out["emb"].shape == (5, 8)
+    assert (out["emb"] >= 0).all()
+
+
+def test_tpu_model_image_shaped_input():
+    net = resnet_mini(num_classes=2)
+    variables = net.init(jax.random.PRNGKey(0))
+    model = TPUModel(NetworkBundle(net, variables), "img", "out", mini_batch_size=2)
+    flat = np.random.default_rng(0).normal(size=(3, 8 * 8 * 3))
+    out = model.transform(DataFrame.from_dict({"img": flat}))
+    assert out["out"].shape == (3, 2)
+
+
+def test_fixed_minibatch_and_flatten_roundtrip():
+    df = DataFrame.from_dict(
+        {"v": np.arange(10, dtype=np.float64), "s": [f"r{i}" for i in range(10)]}
+    )
+    batched = FixedMiniBatchTransformer(batch_size=4).transform(df)
+    assert len(batched) == 3
+    assert batched.dtype("v") == DataType.ARRAY
+    assert [len(b) for b in batched["v"]] == [4, 4, 2]
+    flat = FlattenBatch().transform(batched)
+    np.testing.assert_array_equal(flat["v"], df["v"])
+    assert list(flat["s"]) == list(df["s"])
+
+
+def test_fixed_minibatch_vector_column():
+    df = DataFrame.from_dict({"x": np.arange(12, dtype=np.float64).reshape(6, 2)})
+    batched = FixedMiniBatchTransformer(batch_size=4).transform(df)
+    assert batched["x"][0].shape == (4, 2)
+    flat = FlattenBatch().transform(batched)
+    assert flat.dtype("x") == DataType.VECTOR
+    np.testing.assert_array_equal(flat["x"], df["x"])
+
+
+def test_dynamic_minibatch_partition_semantics():
+    df = DataFrame.from_dict({"v": np.arange(8, dtype=np.float64)}, num_partitions=2)
+    batched = DynamicMiniBatchTransformer().transform(df)
+    assert len(batched) == 2
+    capped = DynamicMiniBatchTransformer(max_batch_size=3).transform(df)
+    assert [len(b) for b in capped["v"]] == [3, 1, 3, 1]
+
+
+def test_flatten_batch_mismatched_sizes_raises():
+    df = DataFrame.from_dict(
+        {"a": [[1, 2], [3]], "b": [[1], [2, 3]]},
+        types={"a": DataType.ARRAY, "b": DataType.ARRAY},
+    )
+    with pytest.raises(ValueError):
+        FlattenBatch().transform(df)
