@@ -1,0 +1,77 @@
+"""Benchmark entry point — run by the driver on real TPU hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures the BASELINE.json headline config: CIFAR10-shape ResNet-20 batch
+inference through the full product path (DataFrame -> TPUModel.transform ->
+scores column), i.e. the CNTKModel CIFAR10 notebook flow
+(reference: CNTKModel.scala:469-516). Steady-state, compile excluded.
+
+vs_baseline: the reference publishes no absolute numbers (SURVEY.md §6), so
+the bar is BASELINE.md's north star — ">= 1x V100 wall-clock". We use a
+nominal 6,000 imgs/sec for V100-era CNTK ResNet-20 batched eval (documented
+estimate in BASELINE.md; the reference's own per-row JNI path was far below
+this). vs_baseline = measured / 6000.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+V100_CNTK_IMGS_PER_SEC = 6000.0  # documented estimate, see BASELINE.md
+
+N_IMAGES = 16384
+BATCH = 8192
+REPEATS = 3
+
+
+def main() -> int:
+    import jax
+
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.dnn import resnet20_cifar
+    from mmlspark_tpu.dnn.network import NetworkBundle
+    from mmlspark_tpu.models import TPUModel
+
+    rng = np.random.default_rng(0)
+    # uint8 pixels, CIFAR layout: the realistic wire format (4x less
+    # host->HBM traffic than f32; normalization happens on device)
+    imgs = rng.integers(0, 256, size=(N_IMAGES, 32 * 32 * 3), dtype=np.uint8)
+    df = DataFrame.from_dict({"images": imgs})
+
+    net = resnet20_cifar(num_classes=10, compute_dtype="bfloat16")
+    variables = net.init(jax.random.PRNGKey(0))
+    model = TPUModel(
+        NetworkBundle(net, variables),
+        input_col="images",
+        output_col="scores",
+        mini_batch_size=BATCH,
+    )
+
+    model.transform(df.limit(BATCH))  # compile + warmup
+
+    best = 0.0
+    for _ in range(REPEATS):
+        t0 = time.time()
+        out = model.transform(df)
+        dt = time.time() - t0
+        best = max(best, N_IMAGES / dt)
+    assert out["scores"].shape == (N_IMAGES, 10)
+
+    print(
+        json.dumps(
+            {
+                "metric": "cifar10_resnet20_inference",
+                "value": round(best, 1),
+                "unit": "imgs/sec/chip",
+                "vs_baseline": round(best / V100_CNTK_IMGS_PER_SEC, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,7 +30,7 @@ class LayerDef:
     def __init__(self, kind: str, init: Callable, apply: Callable):
         self.kind = kind
         self.init = init      # (rng, cfg, in_shape) -> (params, state, out_shape)
-        self.apply = apply    # (params, state, cfg, x, train, rng) -> (y, new_state)
+        self.apply = apply    # (params, state, cfg, x, train, rng, w) -> (y, new_state)
 
 
 def layer(kind: str):
@@ -65,7 +65,7 @@ def _dense():
         }
         return params, {}, (d_out,)
 
-    def apply(params, state, cfg, x, train, rng):
+    def apply(params, state, cfg, x, train, rng, w=None):
         if x.ndim > 2:
             x = x.reshape(x.shape[0], -1)
         return x @ params["kernel"] + params["bias"], state
@@ -94,7 +94,7 @@ def _conv():
             oh, ow = (h - kh) // stride + 1, (w - kw) // stride + 1
         return params, {}, (oh, ow, c_out)
 
-    def apply(params, state, cfg, x, train, rng):
+    def apply(params, state, cfg, x, train, rng, w=None):
         import jax
 
         stride = cfg.get("stride", 1)
@@ -120,15 +120,26 @@ def _batchnorm():
         state = {"mean": np.zeros((c,), np.float32), "var": np.ones((c,), np.float32)}
         return params, state, in_shape
 
-    def apply(params, state, cfg, x, train, rng):
+    def apply(params, state, cfg, x, train, rng, w=None):
         import jax.numpy as jnp
 
         eps = cfg.get("epsilon", 1e-5)
         momentum = cfg.get("momentum", 0.9)
         if train:
             axes = tuple(range(x.ndim - 1))
-            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
-            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            xf = x.astype(jnp.float32)
+            if w is not None:
+                # Per-row sample weights (zero-weight = padding) must not
+                # contaminate batch statistics: weighted mean/var over
+                # (batch x spatial) positions.
+                ww = w.astype(jnp.float32).reshape((-1,) + (1,) * (x.ndim - 1))
+                spatial = float(np.prod(x.shape[1:-1])) if x.ndim > 2 else 1.0
+                denom = jnp.maximum(jnp.sum(ww), 1e-9) * spatial
+                mean = jnp.sum(xf * ww, axis=axes) / denom
+                var = jnp.sum(((xf - mean) ** 2) * ww, axis=axes) / denom
+            else:
+                mean = jnp.mean(xf, axis=axes)
+                var = jnp.var(xf, axis=axes)
             new_state = {
                 "mean": momentum * state["mean"] + (1 - momentum) * mean,
                 "var": momentum * state["var"] + (1 - momentum) * var,
@@ -148,7 +159,7 @@ def _stateless(fn, shape_fn=None):
         out = shape_fn(cfg, in_shape) if shape_fn else in_shape
         return {}, {}, out
 
-    def apply(params, state, cfg, x, train, rng):
+    def apply(params, state, cfg, x, train, rng, w=None):
         return fn(cfg, x), state
 
     return init, apply
@@ -269,7 +280,7 @@ def _dropout():
     def init(rng, cfg, in_shape):
         return {}, {}, in_shape
 
-    def apply(params, state, cfg, x, train, rng):
+    def apply(params, state, cfg, x, train, rng, w=None):
         if not train or rng is None:
             return x, state
         import jax
@@ -297,17 +308,17 @@ def _residual():
             )
         return {"body": bp, "shortcut": sp}, {"body": bs, "shortcut": ss}, out_shape
 
-    def apply(params, state, cfg, x, train, rng):
+    def apply(params, state, cfg, x, train, rng, w=None):
         # .get with {} fallbacks: empty subtrees (identity shortcut, no BN
         # state) are dropped by the flattened npz save and must not be required
         body = cfg["body"]
         shortcut = cfg.get("shortcut") or []
         y, new_bs, _ = _apply_spec(
-            params.get("body", {}), state.get("body", {}), body, x, train, rng, None
+            params.get("body", {}), state.get("body", {}), body, x, train, rng, None, w
         )
         s, new_ss, _ = _apply_spec(
             params.get("shortcut", {}), state.get("shortcut", {}), shortcut,
-            x, train, rng, None,
+            x, train, rng, None, w,
         )
         return y + s, {"body": new_bs, "shortcut": new_ss}
 
@@ -349,7 +360,7 @@ def _init_spec(rng, spec: Spec, in_shape):
     return params, state, shape
 
 
-def _apply_spec(params, state, spec: Spec, x, train, rng, capture: Optional[set]):
+def _apply_spec(params, state, spec: Spec, x, train, rng, capture: Optional[set], w=None):
     import jax
 
     new_state = {}
@@ -362,7 +373,7 @@ def _apply_spec(params, state, spec: Spec, x, train, rng, capture: Optional[set]
     for cfg, r in zip(spec, rngs):
         d = LAYER_KINDS[cfg["kind"]]
         name = cfg["name"]
-        x, s = d.apply(params.get(name, {}), state.get(name, {}), cfg, x, train, r)
+        x, s = d.apply(params.get(name, {}), state.get(name, {}), cfg, x, train, r, w)
         if s:
             new_state[name] = s
         if capture is not None and name in capture:
@@ -442,10 +453,11 @@ class Network:
         )
         return y
 
-    def apply_and_state(self, variables, x, train: bool = True, rng=None):
+    def apply_and_state(self, variables, x, train: bool = True, rng=None,
+                        sample_weight=None):
         y, new_state, _ = _apply_spec(
             variables["params"], variables["state"], self.spec,
-            self._cast_in(x), train, rng, None,
+            self._cast_in(x), train, rng, None, sample_weight,
         )
         merged = dict(variables["state"])
         merged.update(new_state)

@@ -5,5 +5,6 @@ Equivalent of the reference's cntk-model and image-featurizer modules
 """
 
 from mmlspark_tpu.models.tpu_model import TPUModel
+from mmlspark_tpu.models.tpu_learner import TPULearner
 
-__all__ = ["TPUModel"]
+__all__ = ["TPULearner", "TPUModel"]

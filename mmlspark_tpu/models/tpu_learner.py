@@ -1,0 +1,289 @@
+"""TPULearner — in-process data-parallel deep-net training on a device mesh.
+
+The cntk-train equivalent (reference: CNTKLearner.fit,
+src/cntk-train/src/main/scala/CNTKLearner.scala:102-204). The reference
+trains by writing data to HDFS, generating BrainScript, scp-ing it to GPU
+VMs and running `mpirun ... cntk` over ssh (CommandBuilders.scala:149-269).
+None of that survives the TPU redesign:
+
+- BrainScript config  -> the Network JSON spec (dnn/network.py)
+- CNTKTextFormat + scp -> host arrays `device_put` straight into HBM
+- mpirun + MPI allreduce -> ONE jit-compiled train step whose batch dim is
+  sharded over the mesh "data" axis; XLA inserts the gradient psum over ICI
+- `parallelTrain=true` -> always on; single chip is just a 1-device mesh
+
+Optionally shards dense-layer kernels over a "model" mesh axis (tensor
+parallelism) — computation follows the argument shardings, so the same step
+function serves dp, dp x tp, and single-chip.
+
+Determinism contract: global-batch semantics are identical at any device
+count (BatchNorm batch stats and gradient means are global reductions), so
+the 1-device and 8-device loss trajectories match to float tolerance — the
+test-mode guarantee SURVEY.md §4 carries over from local[*].
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.core.dataframe import DataFrame, DataType, Field
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasFeaturesCol,
+    HasLabelCol,
+    Param,
+    TypeConverters,
+    Wrappable,
+)
+from mmlspark_tpu.core.pipeline import Estimator
+from mmlspark_tpu.dnn.network import Network, NetworkBundle
+from mmlspark_tpu.models.tpu_model import TPUModel
+from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+
+LOSSES = ("softmax_cross_entropy", "sigmoid_cross_entropy", "mse")
+
+
+class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
+    network = ComplexParam("network", "The Network spec to train")
+    loss = Param("loss", f"Loss function, one of {LOSSES}", TypeConverters.to_string)
+    optimizer = Param(
+        "optimizer", "Optimizer: sgd | momentum | adam | adamw", TypeConverters.to_string
+    )
+    learning_rate = Param("learning_rate", "Step size", TypeConverters.to_float)
+    momentum = Param("momentum", "Momentum coefficient", TypeConverters.to_float)
+    weight_decay = Param("weight_decay", "AdamW weight decay", TypeConverters.to_float)
+    epochs = Param("epochs", "Number of passes over the data", TypeConverters.to_int)
+    batch_size = Param(
+        "batch_size",
+        "GLOBAL batch size (rounded up to a multiple of the data-axis size)",
+        TypeConverters.to_int,
+    )
+    seed = Param("seed", "PRNG seed for init/shuffle/dropout", TypeConverters.to_int)
+    shuffle = Param("shuffle", "Reshuffle rows every epoch", TypeConverters.to_boolean)
+    output_col = Param("output_col", "Scores column of the fitted model", TypeConverters.to_string)
+    mesh_shape = Param(
+        "mesh_shape",
+        "Device mesh as [dp] or [dp, tp]; default all devices on the data axis",
+        TypeConverters.to_list_int,
+    )
+
+    def __init__(self, network: Optional[Network] = None, **kwargs: Any):
+        super().__init__()
+        self._set_defaults(
+            features_col="features",
+            label_col="label",
+            loss="softmax_cross_entropy",
+            optimizer="momentum",
+            learning_rate=0.01,
+            momentum=0.9,
+            weight_decay=1e-4,
+            epochs=10,
+            batch_size=32,
+            seed=0,
+            shuffle=True,
+            output_col="scores",
+        )
+        if network is not None:
+            self.set(self.network, network)
+        self.set_params(**kwargs)
+
+    def set_network(self, network: Network) -> "TPULearner":
+        return self.set(self.network, network)
+
+    # -- internals -------------------------------------------------------------
+
+    def _make_mesh(self):
+        import jax
+
+        if self.is_set(self.mesh_shape):
+            shape = tuple(self.get(self.mesh_shape))
+        else:
+            shape = (len(jax.devices()),)
+        axes = (DATA_AXIS, MODEL_AXIS)[: len(shape)]
+        return make_mesh(shape, axes, jax.devices()[: int(np.prod(shape))])
+
+    def _param_sharding(self, mesh, variables):
+        """Replicate everything except dense kernels/biases, which shard over
+        the "model" axis when the mesh has one (tensor parallelism)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        has_model = MODEL_AXIS in mesh.axis_names
+        tp = mesh.shape[MODEL_AXIS] if has_model else 1
+        repl = NamedSharding(mesh, P())
+
+        def shard_of(path_leaf):
+            path, leaf = path_leaf
+            if has_model and tp > 1 and len(path) >= 2 and path[-1] == "kernel":
+                if leaf.ndim == 2 and leaf.shape[1] % tp == 0:
+                    return NamedSharding(mesh, P(None, MODEL_AXIS))
+            if has_model and tp > 1 and path and path[-1] == "bias":
+                if leaf.ndim == 1 and leaf.shape[0] % tp == 0:
+                    return NamedSharding(mesh, P(MODEL_AXIS))
+            return repl
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(variables)
+        shardings = [
+            shard_of(([getattr(k, "key", str(k)) for k in path], leaf))
+            for path, leaf in flat
+        ]
+        return jax.tree_util.tree_unflatten(treedef, shardings)
+
+    def _loss_fn(self, net: Network, loss_kind: str):
+        import jax
+        import jax.numpy as jnp
+
+        def compute(params, state, x, y, w, rng):
+            variables = {"params": params, "state": state}
+            logits, new_state = net.apply_and_state(
+                variables, x, train=True, rng=rng, sample_weight=w
+            )
+            logits = logits.astype(jnp.float32)
+            if loss_kind == "softmax_cross_entropy":
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                per = -jnp.take_along_axis(logp, y[:, None].astype(jnp.int32), axis=-1)[:, 0]
+            elif loss_kind == "sigmoid_cross_entropy":
+                z = logits[:, 0] if logits.ndim == 2 else logits
+                yf = y.astype(jnp.float32)
+                per = jnp.maximum(z, 0) - z * yf + jnp.log1p(jnp.exp(-jnp.abs(z)))
+            elif loss_kind == "mse":
+                yt = y.astype(jnp.float32)
+                if logits.ndim == 2 and yt.ndim == 1:
+                    yt = yt[:, None]
+                per = jnp.mean((logits - yt) ** 2, axis=-1)
+            else:
+                raise ValueError(f"unknown loss {loss_kind!r}; one of {LOSSES}")
+            total_w = jnp.maximum(jnp.sum(w), 1e-9)
+            return jnp.sum(per * w) / total_w, new_state
+
+        return compute
+
+    def _optimizer(self):
+        import optax
+
+        kind = self.get(self.optimizer)
+        lr = self.get(self.learning_rate)
+        if kind == "sgd":
+            return optax.sgd(lr)
+        if kind == "momentum":
+            return optax.sgd(lr, momentum=self.get(self.momentum))
+        if kind == "adam":
+            return optax.adam(lr)
+        if kind == "adamw":
+            return optax.adamw(lr, weight_decay=self.get(self.weight_decay))
+        raise ValueError(f"unknown optimizer {kind!r}")
+
+    def _extract_xy(self, df: DataFrame) -> Tuple[np.ndarray, np.ndarray]:
+        from mmlspark_tpu.models.tpu_model import extract_feature_matrix
+
+        net: Network = self.get(self.network)
+        fname = self.get(self.features_col)
+        x = extract_feature_matrix(df.column(fname), net.input_shape, fname)
+        ycol = df.column(self.get(self.label_col))
+        if self.get(self.loss) == "mse":
+            y = ycol.values.astype(np.float32)
+        else:
+            y = np.asarray([int(v) for v in ycol.values], dtype=np.int32)
+        return x, y
+
+    # -- fit -------------------------------------------------------------------
+
+    def fit(self, df: DataFrame) -> TPUModel:
+        import jax
+        import jax.numpy as jnp
+
+        log = get_logger("mmlspark_tpu.train")
+        net: Network = self.get(self.network)
+        x, y = self._extract_xy(df)
+        n = x.shape[0]
+        if n == 0:
+            raise ValueError("cannot fit on an empty DataFrame")
+
+        mesh = self._make_mesh()
+        dp = mesh.shape[DATA_AXIS]
+        bs = -(-self.get(self.batch_size) // dp) * dp
+        rng = np.random.default_rng(self.get(self.seed))
+        key = jax.random.PRNGKey(self.get(self.seed))
+
+        variables = net.init(key)
+        tx = self._optimizer()
+        opt_state = tx.init(variables["params"])
+        train_state = {
+            "params": variables["params"],
+            "state": variables["state"],
+            "opt": opt_state,
+        }
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        state_shard = self._param_sharding(mesh, train_state)
+        train_state = jax.device_put(train_state, state_shard)
+        x_spec = [DATA_AXIS] + [None] * (x.ndim - 1)
+        x_shard = NamedSharding(mesh, P(*x_spec))
+        y_spec = [DATA_AXIS] + [None] * (y.ndim - 1)
+        y_shard = NamedSharding(mesh, P(*y_spec))
+        w_shard = NamedSharding(mesh, P(DATA_AXIS))
+
+        compute = self._loss_fn(net, self.get(self.loss))
+
+        def step(ts, bx, by, bw, step_key):
+            def lf(params):
+                return compute(params, ts["state"], bx, by, bw, step_key)
+
+            (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(ts["params"])
+            updates, new_opt = tx.update(grads, ts["opt"], ts["params"])
+            import optax
+
+            new_params = optax.apply_updates(ts["params"], updates)
+            return {"params": new_params, "state": new_state, "opt": new_opt}, loss
+
+        jit_step = jax.jit(step, donate_argnums=(0,))
+
+        losses: List[float] = []
+        steps_per_epoch = -(-n // bs)  # ceil: the final partial batch is
+        # padded with zero-weight rows, never dropped
+        for epoch in range(self.get(self.epochs)):
+            order = rng.permutation(n) if self.get(self.shuffle) else np.arange(n)
+            epoch_loss = 0.0
+            count = 0
+            for s in range(steps_per_epoch):
+                idx = order[s * bs : (s + 1) * bs]
+                if len(idx) == 0:
+                    continue
+                bx, by = x[idx], y[idx]
+                bw = np.ones(len(idx), np.float32)
+                if len(idx) < bs:  # pad final partial batch with zero weight
+                    pad = bs - len(idx)
+                    bx = np.concatenate([bx, np.repeat(bx[-1:], pad, axis=0)])
+                    by = np.concatenate([by, np.repeat(by[-1:], pad, axis=0)])
+                    bw = np.concatenate([bw, np.zeros(pad, np.float32)])
+                key, sub = jax.random.split(key)
+                train_state, loss = jit_step(
+                    train_state,
+                    jax.device_put(bx, x_shard),
+                    jax.device_put(by, y_shard),
+                    jax.device_put(bw, w_shard),
+                    sub,
+                )
+                epoch_loss += float(loss) * len(idx)
+                count += len(idx)
+            losses.append(epoch_loss / max(1, count))
+            log.debug("epoch %d loss %.5f", epoch, losses[-1])
+
+        final = jax.device_get(
+            {"params": train_state["params"], "state": train_state["state"]}
+        )
+        bundle = NetworkBundle(net, final)
+        model = TPUModel(
+            bundle,
+            input_col=self.get(self.features_col),
+            output_col=self.get(self.output_col),
+        )
+        model._loss_history = losses
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.VECTOR)]

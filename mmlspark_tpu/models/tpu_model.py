@@ -32,6 +32,41 @@ from mmlspark_tpu.dnn.network import Network, NetworkBundle
 from mmlspark_tpu.parallel.mesh import batch_sharding, pad_to_multiple, replicated_sharding
 
 
+def extract_feature_matrix(col, in_shape, col_name: str = "features") -> np.ndarray:
+    """DataFrame Column -> (n, *in_shape) ndarray, shared by TPUModel and
+    TPULearner so training and inference accept identical inputs.
+
+    Keeps narrow dtypes (uint8 pixels) for the host->HBM transfer — 4x less
+    traffic than float32; networks cast to their compute dtype on device
+    (Network._cast_in). Only widens types jax can't ingest (object, 64-bit).
+    """
+    from mmlspark_tpu.core.dataframe import DataType as DT
+
+    if col.dtype == DT.VECTOR:
+        x = col.values
+    elif col.dtype.is_numeric:
+        x = col.values.reshape(-1, 1)
+    else:
+        raise TypeError(
+            f"column {col_name!r} must be VECTOR or numeric, got "
+            f"{col.dtype.value}; run UnrollImage / Featurize first"
+        )
+    if x.dtype == object or x.dtype.kind not in "fiu":
+        x = np.stack([np.asarray(v, dtype=np.float32) for v in x]) if x.dtype == object else x.astype(np.float32)
+    elif x.dtype.itemsize == 8:  # no f64/i64 on TPU
+        x = x.astype(np.float32 if x.dtype.kind == "f" else np.int32)
+    in_shape = tuple(in_shape)
+    flat_dim = int(np.prod(in_shape))
+    if x.ndim == 2 and x.shape[1] == flat_dim and len(in_shape) > 1:
+        x = x.reshape((-1,) + in_shape)
+    elif x.shape[1:] != in_shape:
+        raise ValueError(
+            f"column {col_name!r} shape {x.shape[1:]} incompatible with "
+            f"network input {in_shape}"
+        )
+    return x
+
+
 class TPUModel(Model, Wrappable):
     """Run a Network over an input VECTOR column, producing an output column.
 
@@ -172,14 +207,26 @@ class TPUModel(Model, Wrappable):
             in_shard = None
 
         n = x.shape[0]
+        # Keep a small in-flight window: JAX's async dispatch overlaps the
+        # host->HBM transfer with MXU compute, while draining early batches
+        # bounds peak device memory at O(window * batch), not O(dataset).
+        window = 4
+        pending = []
         outs = []
+
+        def drain(k):
+            while len(pending) > k:
+                y, real = pending.pop(0)
+                outs.append(np.asarray(y[:real], dtype=np.float32))
+
         for start in range(0, n, bs):
             chunk = x[start : start + bs]
             padded, real = pad_to_multiple(chunk, bs, axis=0)
             if in_shard is not None:
                 padded = jax.device_put(padded, in_shard)
-            y = fn(variables, padded)
-            outs.append(np.asarray(y[:real], dtype=np.float32))
+            pending.append((fn(variables, padded), real))
+            drain(window)
+        drain(0)
         if not outs:
             out_dim = net.out_shape()
             return np.zeros((0,) + tuple(out_dim), np.float32)
@@ -195,28 +242,8 @@ class TPUModel(Model, Wrappable):
 
     def transform(self, df: DataFrame) -> DataFrame:
         in_col = self.get(self.input_col)
-        col = df.column(in_col)
         net = self.get_model().network
-        in_shape = net.input_shape
-
-        if col.dtype == DataType.VECTOR:
-            x = col.values.astype(np.float32)
-        elif col.dtype.is_numeric:
-            x = col.values.astype(np.float32).reshape(-1, 1)
-        else:
-            raise TypeError(
-                f"TPUModel input column {in_col!r} must be VECTOR or numeric, "
-                f"got {col.dtype.value}; run UnrollImage / Featurize first"
-            )
-        flat_dim = int(np.prod(in_shape))
-        if x.ndim == 2 and x.shape[1] == flat_dim and len(in_shape) > 1:
-            x = x.reshape((-1,) + tuple(in_shape))
-        elif x.shape[1:] != tuple(in_shape):
-            raise ValueError(
-                f"input shape {x.shape[1:]} incompatible with network input "
-                f"{tuple(in_shape)}"
-            )
-
+        x = extract_feature_matrix(df.column(in_col), net.input_shape, in_col)
         y = self._eval_batches(x)
         if self.get(self.convert_output_to_dense_vector) and y.ndim > 2:
             y = y.reshape(y.shape[0], -1)
