@@ -1,0 +1,36 @@
+"""gbdt — TPU-native gradient-boosted decision trees.
+
+The LightGBM-equivalent learner (reference: src/lightgbm, SURVEY.md §2.2 —
+"the heart of the port"). The reference wraps C++ LightGBM: per-executor
+histogram building with a native TCP allreduce ring inside
+LGBM_BoosterUpdateOneIter (TrainUtils.scala:90-98, LightGBMUtils.scala:97-137
+rendezvous). The TPU redesign:
+
+- Dataset construction (LGBM_DatasetCreateFromMat) -> host quantile binning
+  (binning.BinMapper), binned int8/int16 features device_put once, resident
+  in HBM for the whole fit.
+- Histogram build + allreduce -> ONE jit scatter-add over (row, feature)
+  pairs; with the batch dim sharded over the mesh "data" axis XLA emits the
+  cross-chip reduction (the psum that replaces the TCP ring).
+- Tree growth (leaf-wise, num_leaves-bounded, like LightGBM) runs on host
+  from pulled histograms — they are KB-sized; the n-row work all stays on
+  device, including the leaf re-assignment and the raw-score update.
+- Scoring (LGBM_BoosterPredictForMat) -> vectorized level-synchronous tree
+  walk, jit over (trees, rows).
+"""
+
+from mmlspark_tpu.gbdt.estimators import (
+    LightGBMClassificationModel,
+    LightGBMClassifier,
+    LightGBMRegressionModel,
+    LightGBMRegressor,
+)
+from mmlspark_tpu.gbdt.booster import Booster
+
+__all__ = [
+    "Booster",
+    "LightGBMClassificationModel",
+    "LightGBMClassifier",
+    "LightGBMRegressionModel",
+    "LightGBMRegressor",
+]

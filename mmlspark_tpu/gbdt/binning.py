@@ -1,0 +1,133 @@
+"""Feature quantization: raw float matrix -> small-int bin matrix.
+
+The Dataset-construction half of LightGBM (reference marshalling:
+LightGBMUtils.scala:316-395 generateDenseDataset — the per-element SWIG copy
+this design removes). Bin semantics:
+
+    bin 0          : missing (NaN)
+    bins 1..n_f    : quantile bins in value order (numerical features), or
+                     category index + 1 (categorical features)
+
+Numerical split "bin <= t" therefore means "value <= upper_edge[t] OR
+missing" — missing goes left. That is LightGBM's default_left=true
+convention for NaN.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class BinMapper:
+    """Per-feature quantile binning, fit on (a sample of) the data."""
+
+    def __init__(
+        self,
+        max_bin: int = 255,
+        categorical_indexes: Sequence[int] = (),
+        sample_cap: int = 200_000,
+        seed: int = 0,
+    ):
+        self.max_bin = int(max_bin)
+        self.categorical_indexes = sorted(set(int(i) for i in categorical_indexes))
+        self.sample_cap = sample_cap
+        self.seed = seed
+        self.upper_edges: List[np.ndarray] = []  # per feature, ascending
+        self.n_bins: List[int] = []              # including the missing bin
+        self.num_features = 0
+
+    def is_categorical(self, feature: int) -> bool:
+        return feature in self.categorical_indexes
+
+    def fit(self, x: np.ndarray) -> "BinMapper":
+        # f32 throughout: scoring runs in f32 on device, so bin edges must be
+        # f32-representable or boundary values route differently at predict
+        x = np.asarray(x, dtype=np.float32).astype(np.float64)
+        n, f = x.shape
+        self.num_features = f
+        rng = np.random.default_rng(self.seed)
+        rows = (
+            rng.choice(n, self.sample_cap, replace=False)
+            if n > self.sample_cap
+            else np.arange(n)
+        )
+        self.upper_edges = []
+        self.n_bins = []
+        for j in range(f):
+            v = x[rows, j]
+            v = v[~np.isnan(v)]
+            if self.is_categorical(j):
+                # categorical slots are already small non-negative ints
+                # (reference: categoricalSlotIndexes, LightGBMParams.scala)
+                max_cat = int(v.max()) if len(v) else 0
+                n_cats = min(max_cat + 1, self.max_bin - 1)
+                self.upper_edges.append(np.arange(n_cats, dtype=np.float64))
+                self.n_bins.append(n_cats + 1)
+                continue
+            uniq = np.unique(v)
+            if len(uniq) == 0:
+                edges = np.array([0.0])
+            elif len(uniq) <= self.max_bin - 1:
+                edges = uniq
+            else:
+                qs = np.linspace(0, 1, self.max_bin)[1:]
+                edges = np.unique(np.quantile(v, qs, method="lower"))
+                if edges[-1] < uniq[-1]:
+                    edges = np.append(edges, uniq[-1])
+            self.upper_edges.append(edges.astype(np.float64))
+            self.n_bins.append(len(edges) + 1)
+        return self
+
+    def transform(self, x: np.ndarray) -> np.ndarray:
+        """-> (n, f) int32 bins (0 = missing)."""
+        x = np.asarray(x, dtype=np.float32).astype(np.float64)
+        n, f = x.shape
+        if f != self.num_features:
+            raise ValueError(f"expected {self.num_features} features, got {f}")
+        out = np.zeros((n, f), dtype=np.int32)
+        for j in range(f):
+            v = x[:, j]
+            nan = np.isnan(v)
+            if self.is_categorical(j):
+                cats = np.clip(v, 0, self.n_bins[j] - 2).astype(np.int32)
+                bins = cats + 1
+            else:
+                edges = self.upper_edges[j]
+                # value <= edges[i]  =>  bin i+1 (searchsorted 'left' puts
+                # v == edge into that edge's bin)
+                bins = np.searchsorted(edges, v, side="left").astype(np.int32) + 1
+                bins = np.minimum(bins, len(edges))  # values above last edge
+            bins[nan] = 0
+            out[:, j] = bins
+        return out
+
+    @property
+    def max_n_bins(self) -> int:
+        return max(self.n_bins) if self.n_bins else 1
+
+    def threshold_value(self, feature: int, threshold_bin: int) -> float:
+        """Raw-value threshold for "bin <= threshold_bin": the bin's upper
+        edge, so scoring works on raw floats without the mapper."""
+        edges = self.upper_edges[feature]
+        return float(edges[min(threshold_bin - 1, len(edges) - 1)])
+
+    # -- persistence -----------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "max_bin": self.max_bin,
+            "categorical_indexes": self.categorical_indexes,
+            "num_features": self.num_features,
+            "n_bins": self.n_bins,
+            "upper_edges": [e.tolist() for e in self.upper_edges],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BinMapper":
+        m = cls(d["max_bin"], d["categorical_indexes"])
+        m.num_features = d["num_features"]
+        m.n_bins = list(d["n_bins"])
+        m.upper_edges = [np.asarray(e, dtype=np.float64) for e in d["upper_edges"]]
+        return m

@@ -1,0 +1,131 @@
+"""Device-side GBDT kernels: histogram scatter-add, leaf assignment, tree walk.
+
+These are the ops that touch all n rows; everything else in the grower works
+on KB-sized histograms on host. All functions are jit-compiled with static
+(F, B) so one program serves the whole fit, and all row-dim inputs may be
+sharded over a mesh "data" axis — XLA's SPMD partitioner inserts the
+cross-chip reduction for the replicated histogram output, which is exactly
+the per-feature histogram allreduce the reference gets from LightGBM's
+native TCP ring (SURVEY.md §2.7 item 2, TrainUtils.scala:217).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins",))
+def leaf_histogram(bins, grad, hess, mask, *, num_bins: int):
+    """Histogram of (grad, hess, count) per (feature, bin) over masked rows.
+
+    bins: (n, F) int32 in [0, num_bins); grad/hess: (n,) f32; mask: (n,) bool.
+    -> (F, num_bins, 3) float32.
+    """
+    import jax.numpy as jnp
+
+    n, f = bins.shape
+    g = jnp.where(mask, grad, 0.0).astype(jnp.float32)
+    h = jnp.where(mask, hess, 0.0).astype(jnp.float32)
+    c = mask.astype(jnp.float32)
+    # flat scatter index per (row, feature): feature*B + bin
+    idx = bins + jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins
+    updates = jnp.stack(
+        [jnp.broadcast_to(g[:, None], (n, f)),
+         jnp.broadcast_to(h[:, None], (n, f)),
+         jnp.broadcast_to(c[:, None], (n, f))],
+        axis=-1,
+    )
+    flat = jnp.zeros((f * num_bins, 3), jnp.float32)
+    flat = flat.at[idx.reshape(-1)].add(updates.reshape(-1, 3))
+    return flat.reshape(f, num_bins, 3)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def split_rows(assign, feature_bins, member, slot, new_slot):
+    """Send rows of leaf `slot` whose feature bin is NOT in `member` to
+    `new_slot` (right child). member: (B,) bool — True = go left.
+
+    assign: (n,) int32; feature_bins: (n,) int32.
+    """
+    import jax.numpy as jnp
+
+    go_left = member[feature_bins]
+    return jnp.where((assign == slot) & ~go_left, new_slot, assign).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def add_leaf_outputs(raw, assign, leaf_values):
+    """raw += leaf_values[assign] — the training-time prediction update:
+    `assign` already holds each row's final leaf, so scoring the new tree is
+    one gather (no tree walk)."""
+    return raw + leaf_values[assign]
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def walk_trees_binned(bins, feats, members, lefts, rights, is_leaf, values,
+                      *, max_depth: int):
+    """Score rows through a stack of trees using BINNED features.
+
+    bins: (n, F) int32. Tree arrays are padded to (T, m):
+    feats (T,m) int32, members (T,m,B) bool (True=left), lefts/rights (T,m),
+    is_leaf (T,m) bool, values (T,m) f32. -> (n, T) leaf outputs.
+    """
+    import jax.numpy as jnp
+
+    def one_tree(feat, member, left, right, leaf, value):
+        node = jnp.zeros(bins.shape[0], jnp.int32)
+
+        def step(node, _):
+            f = feat[node]                      # (n,)
+            b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0]
+            go_left = member[node, b]
+            nxt = jnp.where(go_left, left[node], right[node])
+            node = jnp.where(leaf[node], node, nxt)
+            return node, None
+
+        node, _ = jax.lax.scan(step, node, None, length=max_depth)
+        return value[node]
+
+    outs = jax.vmap(one_tree)(feats, members, lefts, rights, is_leaf, values)
+    return outs.T  # (n, T)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def walk_trees_raw(x, feats, thresholds, is_cat, cat_masks, lefts, rights,
+                   is_leaf, values, *, max_depth: int):
+    """Score rows through trees from RAW float features (no binner needed —
+    the standalone-model path, like LGBM_BoosterPredictForMat).
+
+    x: (n, F) f32 (NaN allowed). thresholds (T,m) f32; is_cat (T,m) bool;
+    cat_masks (T,m,C) bool over integer category values. -> (n, T).
+    """
+    import jax.numpy as jnp
+
+    n = x.shape[0]
+    cat_size = cat_masks.shape[-1]
+
+    def one_tree(feat, thr, cat, cmask, left, right, leaf, value):
+        node = jnp.zeros(n, jnp.int32)
+
+        def step(node, _):
+            f = feat[node]
+            v = jnp.take_along_axis(x, f[:, None], axis=1)[:, 0]
+            nan = jnp.isnan(v)
+            num_left = nan | (v <= thr[node])
+            vi = jnp.clip(jnp.where(nan, -1, v).astype(jnp.int32), 0, cat_size - 1)
+            cat_left = cmask[node, vi] & ~nan
+            go_left = jnp.where(cat[node], cat_left, num_left)
+            nxt = jnp.where(go_left, left[node], right[node])
+            node = jnp.where(leaf[node], node, nxt)
+            return node, None
+
+        node, _ = jax.lax.scan(step, node, None, length=max_depth)
+        return value[node]
+
+    outs = jax.vmap(one_tree)(
+        feats, thresholds, is_cat, cat_masks, lefts, rights, is_leaf, values
+    )
+    return outs.T

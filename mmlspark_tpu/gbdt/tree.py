@@ -1,0 +1,358 @@
+"""Decision tree structure, host-side split finding, and the leaf-wise grower.
+
+The grower is the TPU re-design of LightGBM's SerialTreeLearner +
+data_parallel mode (reference semantics: LightGBMParams.scala:14-18,
+TrainUtils.scala:90-98): best-first (leaf-wise) growth bounded by num_leaves,
+histogram subtraction for siblings, categorical splits by sorted-gradient
+prefix scan. All O(n) work happens in gbdt/compute.py jit kernels on device;
+this module only ever sees (F, B, 3) histograms.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class GrowConfig:
+    num_leaves: int = 31
+    max_depth: int = -1  # <=0: unlimited
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    max_cat_threshold: int = 32
+    learning_rate: float = 0.1
+
+
+def _thresholded(g: np.ndarray, l1: float) -> np.ndarray:
+    if l1 <= 0:
+        return g
+    return np.sign(g) * np.maximum(np.abs(g) - l1, 0.0)
+
+
+def _leaf_score(g, h, l1, l2):
+    t = _thresholded(np.asarray(g, np.float64), l1)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        # empty bins (h == 0, l2 == 0) yield nan/inf here; callers mask them
+        # out with their validity filters before any argmax
+        return t * t / (np.asarray(h, np.float64) + l2)
+
+
+def _leaf_output(g, h, l1, l2):
+    t = _thresholded(np.asarray(g, np.float64), l1)
+    return -t / (np.asarray(h, np.float64) + l2)
+
+
+@dataclasses.dataclass
+class SplitInfo:
+    gain: float
+    feature: int
+    threshold_bin: int          # numerical: left = bins <= threshold_bin
+    cat_left: Optional[List[int]]  # categorical: bin values going left
+    left: Tuple[float, float, float]   # (G, H, count)
+    right: Tuple[float, float, float]
+
+
+def find_best_split(
+    hist: np.ndarray,
+    n_bins: Sequence[int],
+    categorical: Sequence[bool],
+    cfg: GrowConfig,
+    feature_mask: Optional[np.ndarray] = None,
+) -> Optional[SplitInfo]:
+    """Best split for one leaf from its (F, B, 3) histogram. Vectorized over
+    bins per feature; loops features on host (F is small, B <= 256)."""
+    f_count = hist.shape[0]
+    best: Optional[SplitInfo] = None
+    for f in range(f_count):
+        if feature_mask is not None and not feature_mask[f]:
+            continue
+        nb = n_bins[f]
+        g = hist[f, :nb, 0].astype(np.float64)
+        h = hist[f, :nb, 1].astype(np.float64)
+        c = hist[f, :nb, 2].astype(np.float64)
+        tg, th, tc = g.sum(), h.sum(), c.sum()
+        if tc < 2 * cfg.min_data_in_leaf:
+            continue
+        parent_score = _leaf_score(tg, th, cfg.lambda_l1, cfg.lambda_l2)
+        if not categorical[f]:
+            # left = bins [0..t] (bin 0 = missing, always left); t in [1, nb-2]
+            cg, ch, cc = np.cumsum(g), np.cumsum(h), np.cumsum(c)
+            ts = np.arange(1, nb - 1)
+            if len(ts) == 0:
+                continue
+            gl, hl, cl = cg[ts], ch[ts], cc[ts]
+            gr, hr, cr = tg - gl, th - hl, tc - cl
+            valid = (
+                (cl >= cfg.min_data_in_leaf)
+                & (cr >= cfg.min_data_in_leaf)
+                & (hl >= cfg.min_sum_hessian_in_leaf)
+                & (hr >= cfg.min_sum_hessian_in_leaf)
+            )
+            if not valid.any():
+                continue
+            gains = (
+                _leaf_score(gl, hl, cfg.lambda_l1, cfg.lambda_l2)
+                + _leaf_score(gr, hr, cfg.lambda_l1, cfg.lambda_l2)
+                - parent_score
+            )
+            gains = np.where(valid, gains, -np.inf)
+            i = int(np.argmax(gains))
+            if gains[i] > max(cfg.min_gain_to_split, best.gain if best else 0.0):
+                best = SplitInfo(
+                    float(gains[i]), f, int(ts[i]), None,
+                    (float(gl[i]), float(hl[i]), float(cl[i])),
+                    (float(gr[i]), float(hr[i]), float(cr[i])),
+                )
+        else:
+            # sorted-categorical: order categories by grad/hess, scan prefixes
+            # from both ends (LightGBM's many-vs-many heuristic)
+            cats = np.arange(1, nb)[c[1:nb] > 0]
+            if len(cats) < 2:
+                continue
+            ratio = g[cats] / (h[cats] + cfg.lambda_l2 + 1e-12)
+            order = cats[np.argsort(ratio)]
+            for direction in (order, order[::-1]):
+                lim = min(len(direction) - 1, cfg.max_cat_threshold)
+                gl = np.cumsum(g[direction])[:lim]
+                hl = np.cumsum(h[direction])[:lim]
+                cl = np.cumsum(c[direction])[:lim]
+                gr, hr, cr = tg - gl, th - hl, tc - cl
+                valid = (
+                    (cl >= cfg.min_data_in_leaf)
+                    & (cr >= cfg.min_data_in_leaf)
+                    & (hl >= cfg.min_sum_hessian_in_leaf)
+                    & (hr >= cfg.min_sum_hessian_in_leaf)
+                )
+                if not valid.any():
+                    continue
+                gains = (
+                    _leaf_score(gl, hl, cfg.lambda_l1, cfg.lambda_l2)
+                    + _leaf_score(gr, hr, cfg.lambda_l1, cfg.lambda_l2)
+                    - parent_score
+                )
+                gains = np.where(valid, gains, -np.inf)
+                i = int(np.argmax(gains))
+                if gains[i] > max(cfg.min_gain_to_split, best.gain if best else 0.0):
+                    best = SplitInfo(
+                        float(gains[i]), f, -1,
+                        [int(b) for b in direction[: i + 1]],
+                        (float(gl[i]), float(hl[i]), float(cl[i])),
+                        (float(gr[i]), float(hr[i]), float(cr[i])),
+                    )
+    return best
+
+
+class Tree:
+    """Grown tree. Children use LightGBM indexing: >=0 internal node id,
+    <0 leaf as ~leaf_index. Leaf values are shrunk (learning rate applied)."""
+
+    def __init__(self):
+        self.split_feature: List[int] = []
+        self.threshold_bin: List[int] = []
+        self.threshold_value: List[float] = []
+        self.is_categorical: List[bool] = []
+        self.cat_left: List[Optional[List[int]]] = []  # raw category values
+        self.left_child: List[int] = []
+        self.right_child: List[int] = []
+        self.split_gain: List[float] = []
+        self.internal_value: List[float] = []
+        self.internal_count: List[int] = []
+        self.leaf_value: List[float] = []
+        self.leaf_count: List[int] = []
+        self.shrinkage: float = 1.0
+
+    @property
+    def num_leaves(self) -> int:
+        return len(self.leaf_value)
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.split_feature)
+
+    def max_depth(self) -> int:
+        if not self.split_feature:
+            return 1
+        depth = [0] * self.num_nodes
+        out = 1
+        for i in range(self.num_nodes):
+            for child in (self.left_child[i], self.right_child[i]):
+                if child >= 0:
+                    depth[child] = depth[i] + 1
+                out = max(out, depth[i] + 2)
+        return out
+
+    def predict_row(self, x: np.ndarray) -> float:
+        """Host reference traversal (tests / tiny batches)."""
+        if self.num_nodes == 0:
+            return self.leaf_value[0] if self.leaf_value else 0.0
+        node = 0
+        while True:
+            f = self.split_feature[node]
+            v = x[f]
+            if self.is_categorical[node]:
+                left = (not np.isnan(v)) and int(v) in self.cat_left[node]
+            else:
+                # f32 comparison: thresholds are f32-representable bin edges
+                # and device scoring runs in f32 (binning.py fit)
+                left = np.isnan(v) or np.float32(v) <= np.float32(
+                    self.threshold_value[node]
+                )
+            nxt = self.left_child[node] if left else self.right_child[node]
+            if nxt < 0:
+                return self.leaf_value[~nxt]
+            node = nxt
+
+
+def grow_tree(
+    bins_dev,
+    feature_cols_dev: list,
+    grad_dev,
+    hess_dev,
+    sample_mask_dev,
+    assign_dev,
+    n_bins: Sequence[int],
+    categorical: Sequence[bool],
+    threshold_value_fn,
+    cfg: GrowConfig,
+    feature_mask: Optional[np.ndarray] = None,
+) -> Tuple[Tree, Any]:
+    """Grow one tree. Returns (tree, final_assign_device).
+
+    bins_dev: (n, F) int32 on device; feature_cols_dev: list of (n,) views
+    (bins_dev[:, f]) to avoid re-slicing; assign_dev starts all-zero.
+    """
+    from mmlspark_tpu.gbdt.compute import leaf_histogram, split_rows
+
+    num_bins = int(max(n_bins))
+    l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+
+    root_hist = np.asarray(
+        leaf_histogram(bins_dev, grad_dev, hess_dev, sample_mask_dev, num_bins=num_bins)
+    )
+    root_g = float(root_hist[0, :, 0].sum())
+    root_h = float(root_hist[0, :, 1].sum())
+    root_c = float(root_hist[0, :, 2].sum())
+
+    tree = Tree()
+    # per-leaf-slot growth state
+    hists: Dict[int, np.ndarray] = {0: root_hist}
+    stats: Dict[int, Tuple[float, float, float]] = {0: (root_g, root_h, root_c)}
+    depths: Dict[int, int] = {0: 0}
+    bests: Dict[int, Optional[SplitInfo]] = {}
+    hangs: Dict[int, Tuple[int, int]] = {}  # slot -> (parent node, 0=left 1=right)
+
+    def can_split(slot: int) -> bool:
+        return cfg.max_depth <= 0 or depths[slot] < cfg.max_depth
+
+    bests[0] = (
+        find_best_split(root_hist, n_bins, categorical, cfg, feature_mask)
+        if can_split(0)
+        else None
+    )
+
+    num_leaves = 1
+    import jax
+
+    while num_leaves < cfg.num_leaves:
+        live = [(s, b) for s, b in bests.items() if b is not None]
+        if not live:
+            break
+        slot, split = max(live, key=lambda sb: sb[1].gain)
+        f = split.feature
+
+        # materialize the node
+        node_id = tree.num_nodes
+        tree.split_feature.append(f)
+        tree.split_gain.append(split.gain)
+        g, h, c = stats[slot]
+        tree.internal_value.append(float(_leaf_output(g, h, l1, l2)))
+        tree.internal_count.append(int(c))
+        if split.cat_left is not None:
+            tree.is_categorical.append(True)
+            tree.threshold_bin.append(-1)
+            tree.threshold_value.append(0.0)
+            # bins are category value + 1 (binning.py)
+            tree.cat_left.append(sorted(b - 1 for b in split.cat_left))
+        else:
+            tree.is_categorical.append(False)
+            tree.threshold_bin.append(split.threshold_bin)
+            tree.threshold_value.append(threshold_value_fn(f, split.threshold_bin))
+            tree.cat_left.append(None)
+        tree.left_child.append(-1)  # patched when the child splits or leafs
+        tree.right_child.append(-1)
+        if slot in hangs:
+            pnode, side = hangs.pop(slot)
+            if side == 0:
+                tree.left_child[pnode] = node_id
+            else:
+                tree.right_child[pnode] = node_id
+
+        # membership vector over bins: True = go left (missing bin 0 left for
+        # numerical, right for categorical — matches raw-value traversal)
+        member = np.zeros(num_bins, bool)
+        if split.cat_left is not None:
+            member[split.cat_left] = True
+        else:
+            member[: split.threshold_bin + 1] = True
+        new_slot = num_leaves
+        assign_dev = split_rows(
+            assign_dev, feature_cols_dev[f],
+            jax.device_put(member), np.int32(slot), np.int32(new_slot),
+        )
+        num_leaves += 1
+
+        # children bookkeeping: left keeps `slot`, right takes `new_slot`
+        parent_hist = hists.pop(slot)
+        bests.pop(slot)
+        depth = depths.pop(slot) + 1
+        (lg, lh, lc), (rg, rh, rc) = split.left, split.right
+        small, big = (
+            (slot, new_slot) if lc <= rc else (new_slot, slot)
+        )
+        small_hist = np.asarray(
+            leaf_histogram(
+                bins_dev, grad_dev, hess_dev,
+                sample_mask_dev & (assign_dev == small),
+                num_bins=num_bins,
+            )
+        )
+        big_hist = parent_hist - small_hist  # sibling subtraction trick
+        hists[slot], hists[new_slot] = (
+            (small_hist, big_hist) if small == slot else (big_hist, small_hist)
+        )
+        stats[slot], stats[new_slot] = (lg, lh, lc), (rg, rh, rc)
+        depths[slot] = depths[new_slot] = depth
+        hangs[slot] = (node_id, 0)
+        hangs[new_slot] = (node_id, 1)
+        for s in (slot, new_slot):
+            more = (
+                (cfg.max_depth <= 0 or depth < cfg.max_depth)
+                and num_leaves < cfg.num_leaves
+            )
+            bests[s] = (
+                find_best_split(hists[s], n_bins, categorical, cfg, feature_mask)
+                if more
+                else None
+            )
+
+    # finalize leaves: slot order IS leaf index order (assign values)
+    tree.leaf_value = [0.0] * num_leaves
+    tree.leaf_count = [0] * num_leaves
+    tree.shrinkage = cfg.learning_rate
+    for s in range(num_leaves):
+        g, h, c = stats[s]
+        tree.leaf_value[s] = float(_leaf_output(g, h, l1, l2)) * cfg.learning_rate
+        tree.leaf_count[s] = int(c)
+        if s in hangs:
+            pnode, side = hangs[s]
+            if side == 0:
+                tree.left_child[pnode] = ~s
+            else:
+                tree.right_child[pnode] = ~s
+    return tree, assign_dev

@@ -117,12 +117,12 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
 
         def shard_of(path_leaf):
             path, leaf = path_leaf
+            # Shard ONLY dense-layer kernels and their own biases (sibling of
+            # a 2-D kernel). BN/conv biases must stay replicated — sharding
+            # them buys no memory and costs an all-gather per step.
             if has_model and tp > 1 and len(path) >= 2 and path[-1] == "kernel":
                 if leaf.ndim == 2 and leaf.shape[1] % tp == 0:
                     return NamedSharding(mesh, P(None, MODEL_AXIS))
-            if has_model and tp > 1 and path and path[-1] == "bias":
-                if leaf.ndim == 1 and leaf.shape[0] % tp == 0:
-                    return NamedSharding(mesh, P(MODEL_AXIS))
             return repl
 
         flat, treedef = jax.tree_util.tree_flatten_with_path(variables)
@@ -183,10 +183,13 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
         fname = self.get(self.features_col)
         x = extract_feature_matrix(df.column(fname), net.input_shape, fname)
         ycol = df.column(self.get(self.label_col))
+        yv = ycol.values
+        if yv.dtype == object:
+            yv = np.asarray(list(yv), dtype=np.float64)
         if self.get(self.loss) == "mse":
-            y = ycol.values.astype(np.float32)
+            y = yv.astype(np.float32)
         else:
-            y = np.asarray([int(v) for v in ycol.values], dtype=np.int32)
+            y = np.rint(yv.astype(np.float64)).astype(np.int32)
         return x, y
 
     # -- fit -------------------------------------------------------------------
