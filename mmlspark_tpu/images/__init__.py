@@ -1,0 +1,26 @@
+"""images — image pipeline stages.
+
+Equivalent of the reference's image-transformer module (OpenCV-backed,
+SURVEY.md §2.2): ImageTransformer.scala:22-335, UnrollImage.scala:25-49.
+
+Design note: pre-resize images are ragged (per-row sizes differ), so the
+transform ops run per-row on host in numpy — exactly where the reference
+runs OpenCV. The TPU path begins at UnrollImage: fixed-size CHW vectors,
+batched into HBM by TPUModel/ImageFeaturizer.
+"""
+
+from mmlspark_tpu.images.transformer import (
+    ImageSetAugmenter,
+    ImageTransformer,
+    ResizeImageTransformer,
+    UnrollBinaryImage,
+    UnrollImage,
+)
+
+__all__ = [
+    "ImageSetAugmenter",
+    "ImageTransformer",
+    "ResizeImageTransformer",
+    "UnrollBinaryImage",
+    "UnrollImage",
+]

@@ -1,0 +1,163 @@
+"""Numpy implementations of the reference's OpenCV image ops.
+
+Semantics match ImageTransformer.scala:22-207 (each op is one stage class
+there): resize (bilinear), crop, color format, flip (OpenCV flip codes), box
+blur, binary threshold, gaussian blur. Images are HxWxC uint8 arrays in BGR
+channel order (the OpenCV/reference convention, preserved so unrolled
+vectors feed models trained on BGR inputs identically).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def resize(img: np.ndarray, height: int, width: int) -> np.ndarray:
+    """Bilinear resize (OpenCV INTER_LINEAR semantics: pixel-center mapping)."""
+    h, w = img.shape[:2]
+    if (h, w) == (height, width):
+        return img.copy()
+    out_y = (np.arange(height) + 0.5) * h / height - 0.5
+    out_x = (np.arange(width) + 0.5) * w / width - 0.5
+    y0 = np.clip(np.floor(out_y).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(out_x).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    fy = np.clip(out_y - y0, 0, 1)[:, None, None]
+    fx = np.clip(out_x - x0, 0, 1)[None, :, None]
+    im = img.astype(np.float64)
+    if im.ndim == 2:
+        im = im[:, :, None]
+        fy, fx = fy[..., 0], fx[..., 0]
+    top = im[y0][:, x0] * (1 - fx) + im[y0][:, x1] * fx
+    bot = im[y1][:, x0] * (1 - fx) + im[y1][:, x1] * fx
+    out = top * (1 - fy) + bot * fy
+    out = np.rint(out).astype(img.dtype)
+    return out if img.ndim == 3 else out[:, :, 0]
+
+
+def crop(img: np.ndarray, x: int, y: int, height: int, width: int) -> np.ndarray:
+    h, w = img.shape[:2]
+    if y + height > h or x + width > w or x < 0 or y < 0:
+        raise ValueError(
+            f"crop ({x},{y},{width}x{height}) outside image {w}x{h}"
+        )
+    return img[y : y + height, x : x + width].copy()
+
+
+def flip(img: np.ndarray, flip_code: int) -> np.ndarray:
+    """OpenCV codes: 0 = around x-axis (vertical flip), >0 = around y-axis
+    (horizontal), <0 = both."""
+    if flip_code == 0:
+        return img[::-1].copy()
+    if flip_code > 0:
+        return img[:, ::-1].copy()
+    return img[::-1, ::-1].copy()
+
+
+def color_format(img: np.ndarray, fmt: str) -> np.ndarray:
+    """Convert BGR to: gray | rgb | bgr (identity)."""
+    fmt = fmt.lower()
+    if fmt in ("bgr", "identity"):
+        return img.copy()
+    if img.ndim == 2 or img.shape[2] == 1:
+        if fmt == "gray":
+            return img.copy()
+        raise ValueError("cannot convert grayscale to color")
+    b, g, r = img[..., 0].astype(np.float64), img[..., 1].astype(np.float64), img[..., 2].astype(np.float64)
+    if fmt == "gray":
+        # OpenCV BGR2GRAY weights
+        y = 0.114 * b + 0.587 * g + 0.299 * r
+        return np.rint(y).astype(img.dtype)
+    if fmt == "rgb":
+        return img[..., ::-1].copy()
+    raise ValueError(f"unknown color format {fmt!r}")
+
+
+def _box_1d(im: np.ndarray, k: int, axis: int) -> np.ndarray:
+    """Mean filter along one axis with BORDER_REFLECT_101-style edge padding."""
+    if k <= 1:
+        return im
+    pad_l = (k - 1) // 2
+    pad_r = k - 1 - pad_l
+    pads = [(0, 0)] * im.ndim
+    pads[axis] = (pad_l, pad_r)
+    padded = np.pad(im, pads, mode="reflect" if im.shape[axis] > 1 else "edge")
+    c = np.cumsum(padded, axis=axis, dtype=np.float64)
+    zero = np.zeros_like(np.take(c, [0], axis=axis))
+    c = np.concatenate([zero, c], axis=axis)
+    n = im.shape[axis]
+    hi = np.take(c, np.arange(k, k + n), axis=axis)
+    lo = np.take(c, np.arange(0, n), axis=axis)
+    return (hi - lo) / k
+
+
+def blur(img: np.ndarray, height: int, width: int) -> np.ndarray:
+    """Box blur (OpenCV Imgproc.blur) with reflect borders."""
+    out = _box_1d(img.astype(np.float64), int(height), 0)
+    out = _box_1d(out, int(width), 1)
+    return np.rint(out).astype(img.dtype)
+
+
+def threshold(img: np.ndarray, thresh: float, max_val: float,
+              threshold_type: str = "binary") -> np.ndarray:
+    """OpenCV threshold types: binary | binary_inv | trunc | tozero |
+    tozero_inv."""
+    im = img.astype(np.float64)
+    t = float(thresh)
+    if threshold_type == "binary":
+        out = np.where(im > t, max_val, 0)
+    elif threshold_type == "binary_inv":
+        out = np.where(im > t, 0, max_val)
+    elif threshold_type == "trunc":
+        out = np.minimum(im, t)
+    elif threshold_type == "tozero":
+        out = np.where(im > t, im, 0)
+    elif threshold_type == "tozero_inv":
+        out = np.where(im > t, 0, im)
+    else:
+        raise ValueError(f"unknown threshold type {threshold_type!r}")
+    return out.astype(img.dtype)
+
+
+def gaussian_kernel(img: np.ndarray, aperture_size: int, sigma: float) -> np.ndarray:
+    """Gaussian blur (OpenCV GaussianBlur), separable implementation."""
+    k = int(aperture_size)
+    if k % 2 == 0:
+        k += 1
+    if sigma <= 0:  # OpenCV default sigma from kernel size
+        sigma = 0.3 * ((k - 1) * 0.5 - 1) + 0.8
+    r = k // 2
+    xs = np.arange(-r, r + 1, dtype=np.float64)
+    kern = np.exp(-(xs ** 2) / (2 * sigma * sigma))
+    kern /= kern.sum()
+
+    def conv_axis(im, axis):
+        pads = [(0, 0)] * im.ndim
+        pads[axis] = (r, r)
+        padded = np.pad(im, pads, mode="reflect" if im.shape[axis] > 1 else "edge")
+        out = np.zeros_like(im, dtype=np.float64)
+        for i, kv in enumerate(kern):
+            sl = [slice(None)] * im.ndim
+            sl[axis] = slice(i, i + im.shape[axis])
+            out += kv * padded[tuple(sl)]
+        return out
+
+    out = conv_axis(img.astype(np.float64), 0)
+    out = conv_axis(out, 1)
+    return np.rint(out).astype(img.dtype)
+
+
+OPS = {
+    "resize": lambda img, p: resize(img, p["height"], p["width"]),
+    "crop": lambda img, p: crop(img, p["x"], p["y"], p["height"], p["width"]),
+    "colorformat": lambda img, p: color_format(img, p["format"]),
+    "flip": lambda img, p: flip(img, p["flip_code"]),
+    "blur": lambda img, p: blur(img, p["height"], p["width"]),
+    "threshold": lambda img, p: threshold(
+        img, p["threshold"], p["max_val"], p.get("threshold_type", "binary")
+    ),
+    "gaussiankernel": lambda img, p: gaussian_kernel(
+        img, p["aperture_size"], p["sigma"]
+    ),
+}

@@ -1,0 +1,247 @@
+"""Image pipeline stages: ImageTransformer, UnrollImage, augmentation.
+
+Reference: image-transformer/src/main/scala/ImageTransformer.scala:22-335
+(fluent stage-list transformer), UnrollImage.scala:25-49 (image struct ->
+CHW DenseVector in BGR order — the layout CNTK consumed and our Networks
+consume after reshape), ResizeImageTransformer (pure-JVM fallback, here the
+same numpy path), ImageSetAugmenter (flip augmentation producing extra rows).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType, Field
+from mmlspark_tpu.core.params import Param, TypeConverters, Wrappable
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.schema import make_image_row
+from mmlspark_tpu.images import ops
+
+
+class ImageTransformer(Transformer, Wrappable):
+    """Apply a list of image ops per row; fluent builder API mirrors the
+    reference (it.resize(h, w).crop(...).flip(...))."""
+
+    stages = Param("stages", "Image processing stages (list of op dicts)", TypeConverters.to_list)
+    input_col = Param("input_col", "The name of the input column", TypeConverters.to_string)
+    output_col = Param("output_col", "The name of the output column", TypeConverters.to_string)
+
+    def __init__(self, input_col: str = "image", output_col: Optional[str] = None):
+        super().__init__()
+        self.set(self.stages, [])
+        self.set(self.input_col, input_col)
+        self.set(self.output_col, output_col or input_col)
+
+    def set_input_col(self, v: str):
+        return self.set(self.input_col, v)
+
+    def set_output_col(self, v: str):
+        return self.set(self.output_col, v)
+
+    def _add(self, op: str, **params: Any) -> "ImageTransformer":
+        new = list(self.get(self.stages))
+        new.append({"op": op, **params})
+        return self.set(self.stages, new)
+
+    def resize(self, height: int, width: int) -> "ImageTransformer":
+        return self._add("resize", height=int(height), width=int(width))
+
+    def crop(self, x: int, y: int, height: int, width: int) -> "ImageTransformer":
+        return self._add("crop", x=int(x), y=int(y), height=int(height), width=int(width))
+
+    def color_format(self, fmt: str) -> "ImageTransformer":
+        return self._add("colorformat", format=fmt)
+
+    def flip(self, flip_code: int = 1) -> "ImageTransformer":
+        return self._add("flip", flip_code=int(flip_code))
+
+    def blur(self, height: int, width: int) -> "ImageTransformer":
+        return self._add("blur", height=int(height), width=int(width))
+
+    def threshold(self, threshold: float, max_val: float,
+                  threshold_type: str = "binary") -> "ImageTransformer":
+        return self._add(
+            "threshold", threshold=float(threshold), max_val=float(max_val),
+            threshold_type=threshold_type,
+        )
+
+    def gaussian_kernel(self, aperture_size: int, sigma: float) -> "ImageTransformer":
+        return self._add(
+            "gaussiankernel", aperture_size=int(aperture_size), sigma=float(sigma)
+        )
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        out_col = self.get(self.output_col)
+        if any(f.name == out_col for f in schema):
+            return schema
+        return schema + [Field(out_col, DataType.STRUCT)]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        stage_list = self.get(self.stages)
+        values = df[self.get(self.input_col)]
+        out = np.empty(len(values), dtype=object)
+        for i, row in enumerate(values):
+            if row is None:
+                out[i] = None
+                continue
+            img = np.asarray(row["data"])
+            for st in stage_list:
+                img = ops.OPS[st["op"]](img, st)
+            out[i] = make_image_row(img, row.get("path", ""))
+        return df.with_column(
+            self.get(self.output_col), Column(out, DataType.STRUCT)
+        )
+
+
+class ResizeImageTransformer(Transformer, Wrappable):
+    """Resize-only stage (reference's JVM fallback when OpenCV is absent —
+    same numpy path here, kept for API parity)."""
+
+    input_col = Param("input_col", "The name of the input column", TypeConverters.to_string)
+    output_col = Param("output_col", "The name of the output column", TypeConverters.to_string)
+    height = Param("height", "Target height", TypeConverters.to_int)
+    width = Param("width", "Target width", TypeConverters.to_int)
+
+    def __init__(self, input_col: str = "image", output_col: Optional[str] = None,
+                 height: int = 224, width: int = 224):
+        super().__init__()
+        self.set(self.input_col, input_col)
+        self.set(self.output_col, output_col or input_col)
+        self.set(self.height, height)
+        self.set(self.width, width)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return (
+            ImageTransformer(self.get(self.input_col), self.get(self.output_col))
+            .resize(self.get(self.height), self.get(self.width))
+            .transform(df)
+        )
+
+
+class UnrollImage(Transformer, Wrappable):
+    """Image struct -> flat CHW float VECTOR (BGR channel planes), the layout
+    the reference feeds CNTK (UnrollImage.scala:25-49). All images in the
+    column must share a shape (resize first)."""
+
+    input_col = Param("input_col", "The name of the input column", TypeConverters.to_string)
+    output_col = Param("output_col", "The name of the output column", TypeConverters.to_string)
+
+    def __init__(self, input_col: str = "image", output_col: str = "unrolled"):
+        super().__init__()
+        self.set(self.input_col, input_col)
+        self.set(self.output_col, output_col)
+
+    def set_input_col(self, v: str):
+        return self.set(self.input_col, v)
+
+    def set_output_col(self, v: str):
+        return self.set(self.output_col, v)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.VECTOR)]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        values = df[self.get(self.input_col)]
+        rows = []
+        shape = None
+        for row in values:
+            img = np.asarray(row["data"])
+            if img.ndim == 2:
+                img = img[:, :, None]
+            if shape is None:
+                shape = img.shape
+            elif img.shape != shape:
+                raise ValueError(
+                    f"UnrollImage needs uniform shapes: {img.shape} vs {shape}; "
+                    "resize first"
+                )
+            # HWC -> CHW planes, flattened (reference unroll order)
+            rows.append(np.transpose(img, (2, 0, 1)).reshape(-1).astype(np.float64))
+        out = np.stack(rows) if rows else np.zeros((0, 0))
+        # Layout metadata: consumers (TPUModel) reorder CHW -> their input
+        # layout instead of silently misreading the planes as NHWC
+        meta = {}
+        if shape is not None:
+            h, w, c = shape
+            meta["unrolled"] = {"order": "CHW", "height": h, "width": w, "channels": c}
+        return df.with_column(
+            self.get(self.output_col), out, DataType.VECTOR, metadata=meta
+        )
+
+
+class UnrollBinaryImage(Transformer, Wrappable):
+    """Decode BINARY image bytes and unroll (UnrollImage.scala:177
+    UnrollBinaryImage). Optional uniform resize during decode."""
+
+    input_col = Param("input_col", "The name of the input column", TypeConverters.to_string)
+    output_col = Param("output_col", "The name of the output column", TypeConverters.to_string)
+    height = Param("height", "Optional target height", TypeConverters.to_int)
+    width = Param("width", "Optional target width", TypeConverters.to_int)
+
+    def __init__(self, input_col: str = "value", output_col: str = "unrolled",
+                 height: Optional[int] = None, width: Optional[int] = None):
+        super().__init__()
+        self.set(self.input_col, input_col)
+        self.set(self.output_col, output_col)
+        if height is not None:
+            self.set(self.height, height)
+        if width is not None:
+            self.set(self.width, width)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.VECTOR)]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        from mmlspark_tpu.io.image import decode_image
+
+        values = df[self.get(self.input_col)]
+        imgs = np.empty(len(values), dtype=object)
+        for i, raw in enumerate(values):
+            img = decode_image(bytes(raw))
+            if self.is_set(self.height) and self.is_set(self.width):
+                img_data = ops.resize(
+                    np.asarray(img["data"]), self.get(self.height), self.get(self.width)
+                )
+                img = make_image_row(img_data, img.get("path", ""))
+            imgs[i] = img
+        tmp = df.with_column("__img__", Column(imgs, DataType.STRUCT))
+        unrolled = UnrollImage("__img__", self.get(self.output_col)).transform(tmp)
+        return unrolled.drop("__img__")
+
+
+class ImageSetAugmenter(Transformer, Wrappable):
+    """Dataset augmentation by flips: emits the original rows plus flipped
+    copies (reference: ImageSetAugmenter — flipLeftRight/flipUpDown params)."""
+
+    input_col = Param("input_col", "The name of the input column", TypeConverters.to_string)
+    output_col = Param("output_col", "The name of the output column", TypeConverters.to_string)
+    flip_left_right = Param("flip_left_right", "Add horizontal flips", TypeConverters.to_boolean)
+    flip_up_down = Param("flip_up_down", "Add vertical flips", TypeConverters.to_boolean)
+
+    def __init__(self, input_col: str = "image", output_col: str = "image",
+                 flip_left_right: bool = True, flip_up_down: bool = False):
+        super().__init__()
+        self.set(self.input_col, input_col)
+        self.set(self.output_col, output_col)
+        self.set(self.flip_left_right, flip_left_right)
+        self.set(self.flip_up_down, flip_up_down)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        in_col = self.get(self.input_col)
+        out_col = self.get(self.output_col)
+        base = df.with_column(out_col, df.column(in_col).copy()) if in_col != out_col else df
+        frames = [base]
+        if self.get(self.flip_left_right):
+            frames.append(
+                ImageTransformer(in_col, out_col).flip(1).transform(df)
+            )
+        if self.get(self.flip_up_down):
+            frames.append(
+                ImageTransformer(in_col, out_col).flip(0).transform(df)
+            )
+        from mmlspark_tpu.core.dataframe import concat
+
+        aligned = [f.select(*frames[0].columns) for f in frames]
+        return concat(aligned)

@@ -1,0 +1,13 @@
+"""io — file readers producing BINARY / IMAGE DataFrames.
+
+Equivalent of the reference's io/binary + io/image modules (SURVEY.md §2.4):
+BinaryFileFormat.scala:34-114 (whole-file rows, zip walking, subsampling),
+PatchedImageFileFormat.scala:23 (image reads). The Spark DataSource
+registration (`spark.read.binary`) becomes plain functions returning
+DataFrames.
+"""
+
+from mmlspark_tpu.io.binary import read_binary
+from mmlspark_tpu.io.image import read_images
+
+__all__ = ["read_binary", "read_images"]

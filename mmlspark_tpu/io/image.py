@@ -1,0 +1,95 @@
+"""Image file reading / decoding to the IMAGE row schema.
+
+Reference: io/image PatchedImageFileFormat.scala:23 + ImageUtils. Decoded
+rows follow core/schema.make_image_row: HxWxC uint8, BGR channel order
+(OpenCV convention, like the reference), mode = OpenCV type code.
+
+Codec backend: Pillow (baked into the environment) for jpg/png/bmp/...;
+raw .npy arrays load directly.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType
+from mmlspark_tpu.core.schema import make_image_row
+from mmlspark_tpu.io.binary import read_binary
+
+IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".ppm", ".npy")
+
+
+def decode_image(data: bytes, path: str = "") -> Dict:
+    """bytes -> image row dict (BGR uint8)."""
+    if path.endswith(".npy") or data[:6] == b"\x93NUMPY":
+        arr = np.load(_io.BytesIO(data), allow_pickle=False)
+        return make_image_row(np.asarray(arr, np.uint8), path)
+    from PIL import Image
+
+    with Image.open(_io.BytesIO(data)) as im:
+        if im.mode in ("L", "I;16", "I"):
+            arr = np.asarray(im.convert("L"), np.uint8)
+        elif im.mode == "RGBA":
+            arr = np.asarray(im, np.uint8)[:, :, [2, 1, 0, 3]]  # -> BGRA
+        else:
+            arr = np.asarray(im.convert("RGB"), np.uint8)[:, :, ::-1]  # -> BGR
+        return make_image_row(arr, path)
+
+
+def encode_image(row: Dict, fmt: str = "png") -> bytes:
+    """image row dict -> encoded bytes (inverse of decode_image)."""
+    from PIL import Image
+
+    data = np.asarray(row["data"])
+    if data.ndim == 3 and data.shape[2] == 3:
+        data = data[:, :, ::-1]  # BGR -> RGB
+    elif data.ndim == 3 and data.shape[2] == 4:
+        data = data[:, :, [2, 1, 0, 3]]
+    elif data.ndim == 3 and data.shape[2] == 1:
+        data = data[:, :, 0]
+    buf = _io.BytesIO()
+    Image.fromarray(data).save(buf, format=fmt.upper())
+    return buf.getvalue()
+
+
+def read_images(
+    path: str,
+    recursive: bool = True,
+    sample_ratio: float = 1.0,
+    inspect_zip: bool = True,
+    seed: int = 0,
+    drop_invalid: bool = True,
+    num_partitions: int = 1,
+) -> DataFrame:
+    """Read images under `path` into an IMAGE-schema DataFrame
+    (columns: path STRING, image STRUCT)."""
+    raw = read_binary(
+        path, recursive=recursive, sample_ratio=sample_ratio,
+        inspect_zip=inspect_zip, seed=seed, num_partitions=num_partitions,
+    )
+    paths, images = [], []
+    for p, blob in zip(raw["path"], raw["value"]):
+        base = os.path.basename(p).lower()
+        if not base.endswith(IMAGE_EXTENSIONS):
+            if drop_invalid:
+                continue
+        try:
+            images.append(decode_image(bytes(blob), p))
+            paths.append(p)
+        except Exception:
+            if not drop_invalid:
+                raise
+    img_col = np.empty(len(images), dtype=object)
+    for i, im in enumerate(images):
+        img_col[i] = im
+    return DataFrame(
+        {
+            "path": Column(np.array(paths, dtype=object), DataType.STRING),
+            "image": Column(img_col, DataType.STRUCT),
+        },
+        num_partitions,
+    )

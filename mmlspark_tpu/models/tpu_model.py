@@ -58,7 +58,21 @@ def extract_feature_matrix(col, in_shape, col_name: str = "features") -> np.ndar
     in_shape = tuple(in_shape)
     flat_dim = int(np.prod(in_shape))
     if x.ndim == 2 and x.shape[1] == flat_dim and len(in_shape) > 1:
-        x = x.reshape((-1,) + in_shape)
+        # UnrollImage marks CHW-flattened columns; our networks are NHWC, so
+        # reorder the planes instead of misreading CHW data as HWC
+        unroll = col.metadata.get("unrolled") if hasattr(col, "metadata") else None
+        if (
+            unroll
+            and unroll.get("order") == "CHW"
+            and len(in_shape) == 3
+            and (
+                unroll.get("height"), unroll.get("width"), unroll.get("channels")
+            ) == (in_shape[0], in_shape[1], in_shape[2])
+        ):
+            c, h, w = unroll["channels"], unroll["height"], unroll["width"]
+            x = x.reshape(-1, c, h, w).transpose(0, 2, 3, 1)
+        else:
+            x = x.reshape((-1,) + in_shape)
     elif x.shape[1:] != in_shape:
         raise ValueError(
             f"column {col_name!r} shape {x.shape[1:]} incompatible with "

@@ -1,0 +1,207 @@
+"""Tests: image ops/stages and binary/image readers."""
+
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.core.schema import make_image_row
+from mmlspark_tpu.images import (
+    ImageSetAugmenter,
+    ImageTransformer,
+    ResizeImageTransformer,
+    UnrollBinaryImage,
+    UnrollImage,
+)
+from mmlspark_tpu.images import ops
+from mmlspark_tpu.io import read_binary, read_images
+from mmlspark_tpu.io.image import decode_image, encode_image
+
+
+def _img(h=8, w=6, c=3, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, (h, w, c), dtype=np.uint8)
+
+
+def _img_df(n=3, h=8, w=6):
+    from mmlspark_tpu.core.dataframe import Column
+
+    rows = np.empty(n, dtype=object)
+    for i in range(n):
+        rows[i] = make_image_row(_img(h, w, seed=i), f"img{i}")
+    return DataFrame({"image": Column(rows, DataType.STRUCT)})
+
+
+class TestOps:
+    def test_resize_known_values(self):
+        # 2x upscale of a 2x2 checkerboard: corners keep exact pixel values
+        img = np.array([[[0], [255]], [[255], [0]]], np.uint8).repeat(3, axis=2)
+        out = ops.resize(img, 4, 4)
+        assert out.shape == (4, 4, 3)
+        assert out[0, 0, 0] == 0 and out[0, 3, 0] == 255
+        # downscale back to 2x2 averages symmetric neighborhoods
+        back = ops.resize(out, 2, 2)
+        assert back.shape == (2, 2, 3)
+
+    def test_resize_identity(self):
+        img = _img()
+        np.testing.assert_array_equal(ops.resize(img, 8, 6), img)
+
+    def test_crop_exact(self):
+        img = _img(10, 10)
+        out = ops.crop(img, 2, 3, 4, 5)
+        np.testing.assert_array_equal(out, img[3:7, 2:7])
+        with pytest.raises(ValueError):
+            ops.crop(img, 8, 8, 5, 5)
+
+    def test_flip_codes(self):
+        img = _img()
+        np.testing.assert_array_equal(ops.flip(img, 0), img[::-1])
+        np.testing.assert_array_equal(ops.flip(img, 1), img[:, ::-1])
+        np.testing.assert_array_equal(ops.flip(img, -1), img[::-1, ::-1])
+
+    def test_gray_weights(self):
+        img = np.zeros((1, 1, 3), np.uint8)
+        img[0, 0] = [255, 0, 0]  # pure blue in BGR
+        assert ops.color_format(img, "gray")[0, 0] == round(0.114 * 255)
+
+    def test_bgr_rgb(self):
+        img = _img()
+        np.testing.assert_array_equal(ops.color_format(img, "rgb"), img[:, :, ::-1])
+
+    def test_box_blur_constant_image(self):
+        img = np.full((6, 6, 3), 77, np.uint8)
+        np.testing.assert_array_equal(ops.blur(img, 3, 3), img)
+
+    def test_box_blur_mean(self):
+        img = np.zeros((3, 3, 1), np.uint8)
+        img[1, 1, 0] = 9
+        out = ops.blur(img, 3, 3)
+        assert out[1, 1, 0] == 1  # 9/9
+
+    def test_threshold_types(self):
+        img = np.array([[[10], [200]]], np.uint8)
+        assert ops.threshold(img, 100, 255)[0, 1, 0] == 255
+        assert ops.threshold(img, 100, 255)[0, 0, 0] == 0
+        assert ops.threshold(img, 100, 255, "binary_inv")[0, 0, 0] == 255
+        assert ops.threshold(img, 100, 255, "trunc")[0, 1, 0] == 100
+        assert ops.threshold(img, 100, 255, "tozero")[0, 0, 0] == 0
+
+    def test_gaussian_preserves_constant(self):
+        img = np.full((8, 8, 3), 123, np.uint8)
+        np.testing.assert_array_equal(ops.gaussian_kernel(img, 5, 1.0), img)
+
+
+class TestStages:
+    def test_image_transformer_chain(self):
+        df = _img_df()
+        it = (
+            ImageTransformer("image", "out")
+            .resize(16, 16)
+            .crop(2, 2, 8, 8)
+            .flip(1)
+            .color_format("gray")
+        )
+        out = it.transform(df)
+        row = out["out"][0]
+        assert (row["height"], row["width"], row["nChannels"]) == (8, 8, 1)
+
+    def test_unroll_chw_layout(self):
+        img = _img(4, 5, 3)
+        rows = np.empty(1, dtype=object)
+        rows[0] = make_image_row(img, "p")
+        from mmlspark_tpu.core.dataframe import Column
+
+        df = DataFrame({"image": Column(rows, DataType.STRUCT)})
+        out = UnrollImage("image", "vec").transform(df)
+        v = out["vec"][0]
+        assert v.shape == (3 * 4 * 5,)
+        # CHW: first plane is channel 0 (blue) row-major
+        np.testing.assert_array_equal(
+            v[: 4 * 5].reshape(4, 5), img[:, :, 0].astype(np.float64)
+        )
+
+    def test_unroll_requires_uniform(self):
+        from mmlspark_tpu.core.dataframe import Column
+
+        rows = np.empty(2, dtype=object)
+        rows[0] = make_image_row(_img(4, 4))
+        rows[1] = make_image_row(_img(5, 5))
+        df = DataFrame({"image": Column(rows, DataType.STRUCT)})
+        with pytest.raises(ValueError):
+            UnrollImage("image", "v").transform(df)
+
+    def test_resize_image_transformer(self):
+        df = _img_df()
+        out = ResizeImageTransformer("image", "image", height=4, width=4).transform(df)
+        assert out["image"][0]["height"] == 4
+
+    def test_augmenter_doubles_rows(self):
+        df = _img_df(n=2)
+        out = ImageSetAugmenter("image", "image", flip_left_right=True).transform(df)
+        assert len(out) == 4
+        np.testing.assert_array_equal(
+            np.asarray(out["image"][2]["data"]),
+            np.asarray(df["image"][0]["data"])[:, ::-1],
+        )
+
+
+class TestIO:
+    def test_read_binary_and_zip(self, tmp_path):
+        (tmp_path / "a.bin").write_bytes(b"hello")
+        with zipfile.ZipFile(tmp_path / "arch.zip", "w") as zf:
+            zf.writestr("inner1.txt", b"one")
+            zf.writestr("sub/inner2.txt", b"two")
+        df = read_binary(str(tmp_path))
+        got = {os.path.basename(p): bytes(v) for p, v in zip(df["path"], df["value"])}
+        assert got["a.bin"] == b"hello"
+        assert got["inner1.txt"] == b"one"
+        assert got["inner2.txt"] == b"two"
+        # zip inspection off: archive comes back as raw bytes
+        df2 = read_binary(str(tmp_path), inspect_zip=False)
+        assert len(df2) == 2
+
+    def test_sample_ratio(self, tmp_path):
+        for i in range(50):
+            (tmp_path / f"f{i}.bin").write_bytes(bytes([i]))
+        df = read_binary(str(tmp_path), sample_ratio=0.3, seed=1)
+        assert 3 < len(df) < 30
+
+    def test_image_roundtrip_and_read(self, tmp_path):
+        img = _img(10, 12)
+        row = make_image_row(img, "x")
+        data = encode_image(row, "png")
+        decoded = decode_image(data)
+        np.testing.assert_array_equal(np.asarray(decoded["data"]), img)
+
+        (tmp_path / "one.png").write_bytes(data)
+        (tmp_path / "junk.txt").write_bytes(b"not an image")
+        df = read_images(str(tmp_path))
+        assert len(df) == 1
+        assert df["image"][0]["height"] == 10
+
+    def test_unroll_binary_image(self, tmp_path):
+        img = _img(6, 6)
+        data = encode_image(make_image_row(img), "png")
+        (tmp_path / "i.png").write_bytes(data)
+        df = read_binary(str(tmp_path))
+        out = UnrollBinaryImage("value", "vec", height=3, width=3).transform(df)
+        assert out["vec"].shape == (1, 27)
+
+
+class TestLayoutBridge:
+    def test_chw_unroll_feeds_nhwc_network_correctly(self):
+        """UnrollImage metadata makes extract_feature_matrix un-scramble the
+        CHW planes back into NHWC for our networks."""
+        from mmlspark_tpu.models.tpu_model import extract_feature_matrix
+
+        img = _img(4, 5, 3)
+        from mmlspark_tpu.core.dataframe import Column
+
+        rows = np.empty(1, dtype=object)
+        rows[0] = make_image_row(img)
+        df = DataFrame({"image": Column(rows, DataType.STRUCT)})
+        out = UnrollImage("image", "vec").transform(df)
+        x = extract_feature_matrix(out.column("vec"), (4, 5, 3), "vec")
+        np.testing.assert_array_equal(x[0], img.astype(np.float64))
