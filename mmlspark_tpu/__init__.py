@@ -13,7 +13,7 @@ over ICI for data-parallel reductions); the runtime around it is Python + a
 C++ data-plane extension.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from mmlspark_tpu.core.dataframe import DataFrame, DataType
 from mmlspark_tpu.core.pipeline import (

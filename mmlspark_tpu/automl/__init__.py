@@ -1,0 +1,50 @@
+"""automl — auto-training, evaluation, model selection, tuning.
+
+Equivalent of the reference modules (SURVEY.md §2.3): train
+(TrainClassifier.scala:91-140, TrainRegressor), compute-model-statistics
+(ComputeModelStatistics.scala:69-466), compute-per-instance-statistics
+(ComputePerInstanceStatistics.scala:42), find-best-model
+(FindBestModel.scala:51), tune-hyperparameters
+(TuneHyperparameters.scala:81-112, ParamSpace.scala, HyperparamBuilder,
+DefaultHyperparams).
+"""
+
+from mmlspark_tpu.automl.train import (
+    TrainClassifier,
+    TrainRegressor,
+    TrainedClassifierModel,
+    TrainedRegressorModel,
+)
+from mmlspark_tpu.automl.statistics import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+)
+from mmlspark_tpu.automl.find_best import BestModel, FindBestModel
+from mmlspark_tpu.automl.hyperparam import (
+    DiscreteHyperParam,
+    DoubleRangeHyperParam,
+    GridSpace,
+    HyperparamBuilder,
+    IntRangeHyperParam,
+    RandomSpace,
+)
+from mmlspark_tpu.automl.tune import TuneHyperparameters, TuneHyperparametersModel
+
+__all__ = [
+    "BestModel",
+    "ComputeModelStatistics",
+    "ComputePerInstanceStatistics",
+    "DiscreteHyperParam",
+    "DoubleRangeHyperParam",
+    "FindBestModel",
+    "GridSpace",
+    "HyperparamBuilder",
+    "IntRangeHyperParam",
+    "RandomSpace",
+    "TrainClassifier",
+    "TrainRegressor",
+    "TrainedClassifierModel",
+    "TrainedRegressorModel",
+    "TuneHyperparameters",
+    "TuneHyperparametersModel",
+]

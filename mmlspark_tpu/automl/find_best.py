@@ -1,0 +1,128 @@
+"""FindBestModel — model selection by evaluation metric.
+
+Reference: find-best-model/src/main/scala/FindBestModel.scala:51 +
+EvaluationUtils.scala:13. Fit evaluates every candidate trained model on
+the given dataset and returns a BestModel carrying the winner, its scored
+dataset, its ROC curve, and the all-model metrics DataFrame.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core import metrics as M
+from mmlspark_tpu.core.dataframe import DataFrame, Field
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasEvaluationMetric,
+    Param,
+    TypeConverters,
+    Wrappable,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.automl.statistics import (
+    ComputeModelStatistics,
+    roc_curve,
+)
+
+
+def evaluate_scored(df: DataFrame, label_col: str, metric: str) -> float:
+    stats = ComputeModelStatistics(
+        evaluation_metric="all", label_col=label_col
+    ).transform(df)
+    row = stats.collect()[0]
+    if metric not in row:
+        raise ValueError(
+            f"metric {metric!r} not produced; available: {list(row)}"
+        )
+    return float(row[metric])
+
+
+class FindBestModel(Estimator, HasEvaluationMetric, Wrappable):
+    models = ComplexParam("models", "Candidate trained models")
+
+    def __init__(self, models: Optional[List[Transformer]] = None,
+                 evaluation_metric: str = M.ACCURACY):
+        super().__init__()
+        self._set_defaults(evaluation_metric=M.ACCURACY)
+        if models is not None:
+            self.set(self.models, list(models))
+        self.set(self.evaluation_metric, evaluation_metric)
+
+    def fit(self, df: DataFrame) -> "BestModel":
+        metric = self.get(self.evaluation_metric)
+        larger_better = M.LARGER_IS_BETTER.get(metric, True)
+        rows = []
+        best = None
+        best_value = None
+        best_scored = None
+        for candidate in self.get(self.models):
+            label_col = candidate.get_or_default("label_col", "label")
+            scored = candidate.transform(df)
+            value = evaluate_scored(scored, label_col, metric)
+            rows.append({"model": type(candidate).__name__ + "_" + candidate.uid,
+                         metric: value})
+            better = (
+                best_value is None
+                or (value > best_value if larger_better else value < best_value)
+            )
+            if better:
+                best, best_value, best_scored = candidate, value, scored
+        if best is None:
+            raise ValueError("no models to evaluate")
+        roc = None
+        if M.SCORED_PROBABILITIES_COL in best_scored:
+            probs = best_scored[M.SCORED_PROBABILITIES_COL]
+            scores = probs[:, -1] if probs.ndim == 2 else probs
+            labels = best_scored[best.get_or_default("label_col", "label")]
+            try:
+                roc = roc_curve(np.asarray([float(v) for v in labels]), scores)
+            except (TypeError, ValueError):
+                roc = None
+        model = BestModel(
+            best, best_scored, DataFrame.from_rows(rows), roc, best_value
+        )
+        model.set(model.evaluation_metric, metric)
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        models = self.get(self.models)
+        return models[0].transform_schema(schema) if models else schema
+
+
+class BestModel(Model, HasEvaluationMetric, Wrappable):
+    best_model = ComplexParam("best_model", "The winning model")
+    scored_dataset = ComplexParam("scored_dataset", "Winner's scored eval dataset")
+    all_model_metrics = ComplexParam("all_model_metrics", "Per-candidate metrics")
+    roc_curve_df = ComplexParam("roc_curve_df", "Winner's ROC curve")
+    best_metric_value = Param("best_metric_value", "Winning metric value", TypeConverters.to_float)
+
+    def __init__(self, best_model=None, scored_dataset=None,
+                 all_model_metrics=None, roc=None, best_value: float = 0.0):
+        super().__init__()
+        if best_model is not None:
+            self.set(self.best_model, best_model)
+        if scored_dataset is not None:
+            self.set(self.scored_dataset, scored_dataset)
+        if all_model_metrics is not None:
+            self.set(self.all_model_metrics, all_model_metrics)
+        if roc is not None:
+            self.set(self.roc_curve_df, roc)
+        self.set(self.best_metric_value, float(best_value))
+
+    def get_best_model(self):
+        return self.get(self.best_model)
+
+    def get_all_model_metrics(self) -> DataFrame:
+        return self.get(self.all_model_metrics)
+
+    def get_roc_curve(self) -> Optional[DataFrame]:
+        return self.get_or_default(self.roc_curve_df)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return self.get(self.best_model).transform(df)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return self.get(self.best_model).transform_schema(schema)

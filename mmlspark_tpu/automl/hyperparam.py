@@ -1,0 +1,119 @@
+"""Hyperparameter spaces: grid and random distributions.
+
+Reference: tune-hyperparameters ParamSpace.scala:25-34 (GridSpace /
+RandomSpace), HyperparamBuilder.scala:98, DefaultHyperparams.scala:17-95.
+A param point is {(estimator_uid, param_name): value}.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+
+class HyperParam:
+    def sample(self, rng: np.random.Generator) -> Any:
+        raise NotImplementedError
+
+    def grid_values(self) -> List[Any]:
+        raise NotImplementedError
+
+
+class DiscreteHyperParam(HyperParam):
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return self.values[int(rng.integers(0, len(self.values)))]
+
+    def grid_values(self):
+        return list(self.values)
+
+
+class IntRangeHyperParam(HyperParam):
+    def __init__(self, low: int, high: int):  # [low, high)
+        self.low, self.high = int(low), int(high)
+
+    def sample(self, rng):
+        return int(rng.integers(self.low, self.high))
+
+    def grid_values(self):
+        return list(range(self.low, self.high))
+
+
+class DoubleRangeHyperParam(HyperParam):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = float(low), float(high)
+
+    def sample(self, rng):
+        return float(rng.uniform(self.low, self.high))
+
+    def grid_values(self):
+        return list(np.linspace(self.low, self.high, 5))
+
+
+class HyperparamBuilder:
+    """Collects (estimator, param-name) -> HyperParam entries."""
+
+    def __init__(self):
+        self._entries: List[Tuple[Any, str, HyperParam]] = []
+
+    def add_hyperparam(self, estimator, param_name: str, dist: HyperParam) -> "HyperparamBuilder":
+        estimator.get_param(param_name)  # validate it exists
+        self._entries.append((estimator, param_name, dist))
+        return self
+
+    def build(self) -> List[Tuple[Any, str, HyperParam]]:
+        return list(self._entries)
+
+
+class GridSpace:
+    """Exhaustive cartesian product of grid values (ParamSpace.scala:25)."""
+
+    def __init__(self, entries: List[Tuple[Any, str, HyperParam]]):
+        self.entries = entries
+
+    def param_sets(self) -> Iterator[List[Tuple[Any, str, Any]]]:
+        grids = [e[2].grid_values() for e in self.entries]
+        for combo in itertools.product(*grids):
+            yield [
+                (est, name, value)
+                for (est, name, _), value in zip(self.entries, combo)
+            ]
+
+
+class RandomSpace:
+    """Random sampling from each distribution (ParamSpace.scala:34)."""
+
+    def __init__(self, entries: List[Tuple[Any, str, HyperParam]], seed: int = 0):
+        self.entries = entries
+        self.seed = seed
+
+    def param_sets(self) -> Iterator[List[Tuple[Any, str, Any]]]:
+        rng = np.random.default_rng(self.seed)
+        while True:
+            yield [(est, name, dist.sample(rng)) for est, name, dist in self.entries]
+
+
+class DefaultHyperparams:
+    """Per-learner default search spaces (DefaultHyperparams.scala:17-95)."""
+
+    @staticmethod
+    def for_estimator(estimator) -> List[Tuple[Any, str, HyperParam]]:
+        name = type(estimator).__name__
+        builder = HyperparamBuilder()
+        if name == "LightGBMClassifier" or name == "LightGBMRegressor":
+            builder.add_hyperparam(estimator, "num_leaves", DiscreteHyperParam([15, 31, 63]))
+            builder.add_hyperparam(estimator, "learning_rate", DoubleRangeHyperParam(0.01, 0.3))
+            builder.add_hyperparam(estimator, "num_iterations", DiscreteHyperParam([25, 50, 100]))
+        elif name == "LogisticRegression":
+            builder.add_hyperparam(estimator, "reg_param", DoubleRangeHyperParam(0.0, 0.3))
+            builder.add_hyperparam(estimator, "max_iter", DiscreteHyperParam([20, 50]))
+        elif name == "TPULearner":
+            builder.add_hyperparam(estimator, "learning_rate", DoubleRangeHyperParam(0.001, 0.3))
+            builder.add_hyperparam(estimator, "epochs", DiscreteHyperParam([10, 25, 50]))
+        else:
+            raise ValueError(f"no default hyperparams for {name}")
+        return builder.build()

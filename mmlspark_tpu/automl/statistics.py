@@ -1,0 +1,263 @@
+"""Evaluation metrics: ComputeModelStatistics / ComputePerInstanceStatistics.
+
+Reference: ComputeModelStatistics.scala:69-466 (confusion matrix, accuracy /
+precision / recall, AUC via rank statistic, regression MSE/RMSE/R2/MAE,
+per-class metrics, MetricsLogger) and ComputePerInstanceStatistics.scala:42
+(per-row L1/L2 loss, per-instance log loss). Consumes the metric-name
+constants from core/metrics.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core import metrics as M
+from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType, Field
+from mmlspark_tpu.core.params import (
+    HasEvaluationMetric,
+    HasLabelCol,
+    Param,
+    TypeConverters,
+    Wrappable,
+)
+from mmlspark_tpu.core.pipeline import Transformer
+
+
+def auc_score(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Binary AUROC by the Mann-Whitney rank statistic (getAUC, :376)."""
+    labels = np.asarray(labels) > 0
+    scores = np.asarray(scores, np.float64)
+    n_pos = int(labels.sum())
+    n_neg = len(labels) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    # average ranks for ties (Mann-Whitney requires midranks)
+    uniq, inv, counts = np.unique(scores, return_inverse=True, return_counts=True)
+    cum = np.cumsum(counts)
+    avg_rank = (cum - (counts - 1) / 2.0)[inv]
+    return float(
+        (avg_rank[labels].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+    )
+
+
+def roc_curve(labels: np.ndarray, scores: np.ndarray) -> DataFrame:
+    """ROC points (false_positive_rate, true_positive_rate, threshold)."""
+    labels = np.asarray(labels) > 0
+    order = np.argsort(-np.asarray(scores, np.float64), kind="stable")
+    sorted_labels = labels[order]
+    tps = np.cumsum(sorted_labels)
+    fps = np.cumsum(~sorted_labels)
+    n_pos = max(1, int(labels.sum()))
+    n_neg = max(1, int((~labels).sum()))
+    return DataFrame.from_dict(
+        {
+            "false_positive_rate": np.concatenate([[0.0], fps / n_neg]),
+            "true_positive_rate": np.concatenate([[0.0], tps / n_pos]),
+            "threshold": np.concatenate(
+                [[np.inf], np.asarray(scores, np.float64)[order]]
+            ),
+        }
+    )
+
+
+def confusion_matrix(labels: np.ndarray, predictions: np.ndarray,
+                     num_classes: Optional[int] = None) -> np.ndarray:
+    labels = np.asarray(labels, np.int64)
+    predictions = np.asarray(predictions, np.int64)
+    k = num_classes or int(max(labels.max(), predictions.max())) + 1
+    out = np.zeros((k, k), np.int64)
+    np.add.at(out, (labels, predictions), 1)
+    return out
+
+
+def classification_metrics(labels, predictions, scores=None) -> Dict[str, Any]:
+    cm = confusion_matrix(labels, predictions)
+    k = cm.shape[0]
+    total = cm.sum()
+    acc = float(np.trace(cm)) / max(1, total)
+    per_class_prec, per_class_rec = [], []
+    for c in range(k):
+        tp = cm[c, c]
+        fp = cm[:, c].sum() - tp
+        fn = cm[c, :].sum() - tp
+        per_class_prec.append(tp / max(1, tp + fp))
+        per_class_rec.append(tp / max(1, tp + fn))
+    if k == 2:
+        precision, recall = float(per_class_prec[1]), float(per_class_rec[1])
+    else:  # macro average
+        precision, recall = float(np.mean(per_class_prec)), float(np.mean(per_class_rec))
+    out = {
+        M.ACCURACY: acc,
+        M.PRECISION: precision,
+        M.RECALL: recall,
+        "confusion_matrix": cm,
+        "per_class_precision": per_class_prec,
+        "per_class_recall": per_class_rec,
+    }
+    if scores is not None and k == 2:
+        out[M.AUC] = auc_score(labels, scores)
+    return out
+
+
+def regression_metrics(labels, predictions) -> Dict[str, float]:
+    y = np.asarray(labels, np.float64)
+    p = np.asarray(predictions, np.float64)
+    err = p - y
+    mse = float(np.mean(err ** 2))
+    ss_tot = float(np.sum((y - y.mean()) ** 2))
+    return {
+        M.MSE: mse,
+        M.RMSE: float(np.sqrt(mse)),
+        M.R2: 1.0 - float(np.sum(err ** 2)) / ss_tot if ss_tot else float("nan"),
+        M.MAE: float(np.mean(np.abs(err))),
+    }
+
+
+class ComputeModelStatistics(Transformer, HasLabelCol, HasEvaluationMetric, Wrappable):
+    """Scored DataFrame -> one-row metrics DataFrame."""
+
+    scores_col = Param("scores_col", "Probability / score column", TypeConverters.to_string)
+    scored_labels_col = Param("scored_labels_col", "Predicted label column", TypeConverters.to_string)
+
+    def __init__(self, evaluation_metric: str = "all", label_col: str = "label",
+                 scored_labels_col: str = "scored_labels",
+                 scores_col: Optional[str] = None):
+        super().__init__()
+        self._set_defaults(
+            label_col="label", evaluation_metric="all",
+            scored_labels_col="scored_labels",
+        )
+        self.set(self.evaluation_metric, evaluation_metric)
+        self.set(self.label_col, label_col)
+        self.set(self.scored_labels_col, scored_labels_col)
+        if scores_col:
+            self.set(self.scores_col, scores_col)
+
+    def _is_regression(self, df: DataFrame, labels: np.ndarray) -> bool:
+        metric = self.get(self.evaluation_metric)
+        if metric in M.REGRESSION_METRICS or metric == "regression":
+            return True
+        if metric in M.CLASSIFICATION_METRICS or metric == "classification":
+            return False
+        return not np.allclose(labels, np.rint(labels))
+
+    @staticmethod
+    def _numeric_pair(raw_labels, raw_preds):
+        """Cast label/prediction columns to float, indexing string levels
+        (TrainClassifier keeps original label values in scored_labels)."""
+        try:
+            return (
+                np.asarray([float(v) for v in raw_labels], np.float64),
+                np.asarray([float(v) for v in raw_preds], np.float64),
+                False,
+            )
+        except (TypeError, ValueError):
+            levels = sorted(
+                set(str(v) for v in raw_labels) | set(str(v) for v in raw_preds)
+            )
+            index = {v: float(i) for i, v in enumerate(levels)}
+            return (
+                np.asarray([index[str(v)] for v in raw_labels], np.float64),
+                np.asarray([index[str(v)] for v in raw_preds], np.float64),
+                True,
+            )
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        pred_col = self.get(self.scored_labels_col)
+        if pred_col not in df and M.PREDICTION_COL in df:
+            pred_col = M.PREDICTION_COL
+        labels, preds, was_string = self._numeric_pair(
+            df[self.get(self.label_col)], df[pred_col]
+        )
+        metric = self.get(self.evaluation_metric)
+        log = get_logger("mmlspark_tpu.metrics")
+        if not was_string and self._is_regression(df, labels):
+            stats = regression_metrics(labels, preds)
+            row = {"evaluation_type": "Regression", **stats}
+        else:
+            scores = None
+            scol = self.get_or_default(self.scores_col)
+            if scol is None:
+                for cand in (M.SCORED_PROBABILITIES_COL, "probability", M.SCORES_COL):
+                    if cand in df:
+                        scol = cand
+                        break
+            if scol is not None and scol in df:
+                sv = df[scol]
+                scores = sv[:, -1] if sv.ndim == 2 else sv
+            stats = classification_metrics(
+                labels.astype(np.int64), preds.astype(np.int64), scores
+            )
+            cm = stats.pop("confusion_matrix")
+            stats.pop("per_class_precision")
+            stats.pop("per_class_recall")
+            row = {
+                "evaluation_type": "Classification",
+                "confusion_matrix": cm.astype(np.float64),
+                **stats,
+            }
+        if metric not in ("all", "classification", "regression"):
+            row = {
+                "evaluation_type": row["evaluation_type"],
+                metric: row.get(metric, float("nan")),
+            }
+        for key, value in row.items():
+            if isinstance(value, float):
+                log.info("metric %s=%0.6f", key, value)
+        types = {"confusion_matrix": DataType.VECTOR} if "confusion_matrix" in row else None
+        return DataFrame.from_dict(
+            {k: [v] for k, v in row.items()}, types=types or {}
+        )
+
+
+class ComputePerInstanceStatistics(Transformer, HasLabelCol, HasEvaluationMetric, Wrappable):
+    """Per-row loss columns (ComputePerInstanceStatistics.scala:42):
+    regression -> L1_loss/L2_loss; classification -> log_loss."""
+
+    scores_col = Param("scores_col", "Probability column", TypeConverters.to_string)
+    scored_labels_col = Param("scored_labels_col", "Predicted label column", TypeConverters.to_string)
+
+    def __init__(self, evaluation_metric: str = "auto", label_col: str = "label",
+                 scored_labels_col: str = "scored_labels",
+                 scores_col: Optional[str] = None):
+        super().__init__()
+        self._set_defaults(
+            label_col="label", evaluation_metric="auto",
+            scored_labels_col="scored_labels",
+        )
+        self.set(self.label_col, label_col)
+        self.set(self.evaluation_metric, evaluation_metric)
+        self.set(self.scored_labels_col, scored_labels_col)
+        if scores_col:
+            self.set(self.scores_col, scores_col)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        labels = df[self.get(self.label_col)].astype(np.float64)
+        metric = self.get(self.evaluation_metric)
+        scol = self.get_or_default(self.scores_col)
+        if scol is None:
+            for cand in (M.SCORED_PROBABILITIES_COL, "probability"):
+                if cand in df:
+                    scol = cand
+                    break
+        is_classification = metric == "classification" or (
+            metric == "auto" and scol is not None and scol in df
+        )
+        if is_classification:
+            prob = df[scol]
+            idx = np.clip(labels.astype(np.int64), 0, prob.shape[1] - 1)
+            p_true = np.clip(prob[np.arange(len(labels)), idx], 1e-15, 1.0)
+            return df.with_column("log_loss", -np.log(p_true), DataType.DOUBLE)
+        pred_col = self.get(self.scored_labels_col)
+        if pred_col not in df:
+            for cand in (M.SCORES_COL, M.PREDICTION_COL):
+                if cand in df:
+                    pred_col = cand
+                    break
+        preds = df[pred_col].astype(np.float64)
+        err = preds - labels
+        out = df.with_column("L1_loss", np.abs(err), DataType.DOUBLE)
+        return out.with_column("L2_loss", err ** 2, DataType.DOUBLE)

@@ -1,0 +1,207 @@
+"""TrainClassifier / TrainRegressor — auto-featurizing model wrappers.
+
+Reference: train/src/main/scala/TrainClassifier.scala:91-140 (label
+auto-indexing via ValueIndexer, featurization via Featurize, model fit,
+TrainedClassifierModel that scores and un-indexes labels), AutoTrainer /
+AutoTrainedModel bases, TrainRegressor. Output column names keep the
+reference contract: scored_labels / scores / scored_probabilities
+(core/metrics.py constants).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core import metrics as M
+from mmlspark_tpu.core.dataframe import DataFrame, DataType, Field
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasFeaturesCol,
+    HasLabelCol,
+    Param,
+    TypeConverters,
+    Wrappable,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.schema import find_unused_column_name
+from mmlspark_tpu.featurize import Featurize
+from mmlspark_tpu.stages.dataprep import ValueIndexer, ValueIndexerModel
+
+
+class _AutoTrainer(HasLabelCol, HasFeaturesCol):
+    model = ComplexParam("model", "Inner estimator to auto-train")
+    number_of_features = Param(
+        "number_of_features", "Hash width for string features", TypeConverters.to_int
+    )
+
+    def _feature_inputs(self, df: DataFrame) -> List[str]:
+        label = self.get(self.label_col)
+        return [c for c in df.columns if c != label]
+
+    def _featurize(self, df: DataFrame, label_col: str):
+        feat_col = find_unused_column_name("features", df)
+        featurizer = Featurize(
+            feature_columns=[c for c in df.columns if c != label_col],
+            output_col=feat_col,
+            number_of_features=self.get(self.number_of_features),
+        )
+        return featurizer.fit(df), feat_col
+
+
+class TrainClassifier(Estimator, _AutoTrainer, Wrappable):
+    reindex_label = Param("reindex_label", "Re-index labels to 0..K-1", TypeConverters.to_boolean)
+
+    def __init__(self, model: Optional[Estimator] = None, label_col: str = "label",
+                 number_of_features: int = 4096, reindex_label: bool = True):
+        super().__init__()
+        self._set_defaults(
+            label_col="label", features_col="features", number_of_features=4096,
+            reindex_label=True,
+        )
+        if model is not None:
+            self.set(self.model, model)
+        self.set(self.label_col, label_col)
+        self.set(self.number_of_features, number_of_features)
+        self.set(self.reindex_label, reindex_label)
+
+    def set_model(self, model: Estimator):
+        return self.set(self.model, model)
+
+    def fit(self, df: DataFrame) -> "TrainedClassifierModel":
+        label = self.get(self.label_col)
+        levels = None
+        work = df
+        indexed_label = label
+        if self.get(self.reindex_label):
+            indexed_label = find_unused_column_name("indexed_label", df)
+            indexer: ValueIndexerModel = ValueIndexer(label, indexed_label).fit(df)
+            levels = indexer.get_levels()
+            work = indexer.transform(df)
+            work = work.drop(label)
+        feat_model, feat_col = self._featurize(work, indexed_label)
+        featurized = feat_model.transform(work)
+        inner = self.get(self.model).copy()
+        inner.set("label_col", indexed_label)
+        inner.set("features_col", feat_col)
+        fitted = inner.fit(featurized)
+        model = TrainedClassifierModel(feat_model, fitted, levels, feat_col)
+        model.set(model.label_col, label)
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [
+            Field(M.SCORES_COL, DataType.VECTOR),
+            Field(M.SCORED_PROBABILITIES_COL, DataType.VECTOR),
+            Field(M.SCORED_LABELS_COL, DataType.DOUBLE),
+        ]
+
+
+class TrainedClassifierModel(Model, HasLabelCol, Wrappable):
+    featurize_model = ComplexParam("featurize_model", "Fitted featurizer")
+    inner_model = ComplexParam("inner_model", "Fitted inner model")
+    levels = ComplexParam("levels", "Original label levels (index order)")
+    features_col_name = Param("features_col_name", "Assembled features column", TypeConverters.to_string)
+
+    def __init__(self, featurize_model=None, inner_model=None,
+                 levels: Optional[List[Any]] = None, features_col: str = "features"):
+        super().__init__()
+        self._set_defaults(label_col="label", features_col_name="features")
+        if featurize_model is not None:
+            self.set(self.featurize_model, featurize_model)
+        if inner_model is not None:
+            self.set(self.inner_model, inner_model)
+        if levels is not None:
+            self.set(self.levels, list(levels))
+        self.set(self.features_col_name, features_col)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        featurized = self.get(self.featurize_model).transform(df)
+        inner = self.get(self.inner_model)
+        scored = inner.transform(featurized)
+        # normalize inner column names to the scored_* contract
+        out = df
+        raw_col = inner.get_or_default("raw_prediction_col", "rawPrediction")
+        prob_col = inner.get_or_default("probability_col", "probability")
+        pred_col = inner.get_or_default("prediction_col", "prediction")
+        if raw_col in scored:
+            out = out.with_column(M.SCORES_COL, scored[raw_col], DataType.VECTOR)
+        if prob_col in scored:
+            out = out.with_column(
+                M.SCORED_PROBABILITIES_COL, scored[prob_col], DataType.VECTOR
+            )
+        preds = scored[pred_col]
+        if self.is_set(self.levels):
+            levels = self.get(self.levels)
+            values = [levels[int(p)] for p in preds]
+            out = out.with_column(M.SCORED_LABELS_COL, values)
+        else:
+            out = out.with_column(M.SCORED_LABELS_COL, preds, DataType.DOUBLE)
+        return out
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [
+            Field(M.SCORES_COL, DataType.VECTOR),
+            Field(M.SCORED_PROBABILITIES_COL, DataType.VECTOR),
+            Field(M.SCORED_LABELS_COL, DataType.DOUBLE),
+        ]
+
+
+class TrainRegressor(Estimator, _AutoTrainer, Wrappable):
+    def __init__(self, model: Optional[Estimator] = None, label_col: str = "label",
+                 number_of_features: int = 4096):
+        super().__init__()
+        self._set_defaults(
+            label_col="label", features_col="features", number_of_features=4096
+        )
+        if model is not None:
+            self.set(self.model, model)
+        self.set(self.label_col, label_col)
+        self.set(self.number_of_features, number_of_features)
+
+    def set_model(self, model: Estimator):
+        return self.set(self.model, model)
+
+    def fit(self, df: DataFrame) -> "TrainedRegressorModel":
+        label = self.get(self.label_col)
+        feat_model, feat_col = self._featurize(df, label)
+        featurized = feat_model.transform(df)
+        inner = self.get(self.model).copy()
+        inner.set("label_col", label)
+        inner.set("features_col", feat_col)
+        fitted = inner.fit(featurized)
+        model = TrainedRegressorModel(feat_model, fitted, feat_col)
+        model.set(model.label_col, label)
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(M.SCORES_COL, DataType.DOUBLE)]
+
+
+class TrainedRegressorModel(Model, HasLabelCol, Wrappable):
+    featurize_model = ComplexParam("featurize_model", "Fitted featurizer")
+    inner_model = ComplexParam("inner_model", "Fitted inner model")
+    features_col_name = Param("features_col_name", "Assembled features column", TypeConverters.to_string)
+
+    def __init__(self, featurize_model=None, inner_model=None,
+                 features_col: str = "features"):
+        super().__init__()
+        self._set_defaults(label_col="label", features_col_name="features")
+        if featurize_model is not None:
+            self.set(self.featurize_model, featurize_model)
+        if inner_model is not None:
+            self.set(self.inner_model, inner_model)
+        self.set(self.features_col_name, features_col)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        featurized = self.get(self.featurize_model).transform(df)
+        inner = self.get(self.inner_model)
+        scored = inner.transform(featurized)
+        pred_col = inner.get_or_default("prediction_col", "prediction")
+        return df.with_column(
+            M.SCORES_COL, scored[pred_col].astype(np.float64), DataType.DOUBLE
+        )
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(M.SCORES_COL, DataType.DOUBLE)]

@@ -234,7 +234,12 @@ class Params:
         )
 
     def get_or_default(self, param, default: Any = None) -> Any:
-        param = self._resolve(param)
+        try:
+            param = self._resolve(param)
+        except AttributeError:
+            # tolerate params the class doesn't declare: generic flows probe
+            # e.g. "probability_col" across heterogeneous models
+            return default
         if self.is_defined(param):
             return self.get(param)
         return default

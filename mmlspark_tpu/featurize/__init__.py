@@ -1,0 +1,22 @@
+"""featurize — automatic feature assembly.
+
+Equivalent of the reference's featurize module (SURVEY.md §2.3):
+Featurize.fit (Featurize.scala:83-100) + AssembleFeatures.scala +
+core/spark FastVectorAssembler. Per-type handling mirrors the reference:
+numerics cast to double (mean-imputed), booleans 0/1, categorical metadata
+one-hot, plain strings tokenized+hashed, timestamps decomposed, token
+arrays hashed, vectors passed through, images unrolled — then assembled
+into one dense VECTOR column with slot-name metadata.
+
+Dense width default is the reference's tree/NN setting
+(numFeaturesTreeOrNNBased = 4096, Featurize.scala:13-19) — the 2^18 sparse
+default has no dense-tensor analog worth materializing.
+"""
+
+from mmlspark_tpu.featurize.assemble import (
+    Featurize,
+    FeaturizeModel,
+    FastVectorAssembler,
+)
+
+__all__ = ["FastVectorAssembler", "Featurize", "FeaturizeModel"]

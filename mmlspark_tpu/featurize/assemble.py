@@ -1,0 +1,243 @@
+"""Featurize / FastVectorAssembler implementations."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType, Field
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasInputCols,
+    HasOutputCol,
+    Param,
+    TypeConverters,
+    Wrappable,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.core.schema import CategoricalMap, get_categorical_map, is_image
+
+
+class FastVectorAssembler(Transformer, HasInputCols, HasOutputCol, Wrappable):
+    """Concatenate numeric/vector columns into one VECTOR, writing slot
+    names into ml_attr metadata (reference: core/spark FastVectorAssembler —
+    which keeps only categorical metadata for speed; slot names here are
+    cheap so we keep them all)."""
+
+    def __init__(self, input_cols: Optional[List[str]] = None,
+                 output_col: str = "features"):
+        super().__init__()
+        if input_cols:
+            self.set(self.input_cols, input_cols)
+        self.set(self.output_col, output_col)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.VECTOR)]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        parts: List[np.ndarray] = []
+        names: List[str] = []
+        for col_name in self.get(self.input_cols):
+            col = df.column(col_name)
+            v = col.values
+            if v.ndim == 1:
+                if v.dtype == object:
+                    v = np.array([float(x) for x in v], np.float64)
+                parts.append(v.astype(np.float64)[:, None])
+                names.append(col_name)
+            else:
+                parts.append(v.astype(np.float64))
+                slot_names = col.metadata.get("ml_attr", {}).get("names")
+                if slot_names and len(slot_names) == v.shape[1]:
+                    names.extend(slot_names)
+                else:
+                    names.extend(f"{col_name}_{i}" for i in range(v.shape[1]))
+        out = (
+            np.concatenate(parts, axis=1)
+            if parts
+            else np.zeros((len(df), 0), np.float64)
+        )
+        return df.with_column(
+            self.get(self.output_col), out, DataType.VECTOR,
+            metadata={"ml_attr": {"names": names}},
+        )
+
+
+class Featurize(Estimator, HasOutputCol, Wrappable):
+    """Auto-featurization estimator (Featurize.scala:83-100)."""
+
+    feature_columns = Param(
+        "feature_columns", "Input columns to featurize", TypeConverters.to_list_string
+    )
+    number_of_features = Param(
+        "number_of_features", "Hash width for string columns", TypeConverters.to_int
+    )
+    one_hot_encode_categoricals = Param(
+        "one_hot_encode_categoricals", "One-hot categorical columns", TypeConverters.to_boolean
+    )
+    allow_images = Param("allow_images", "Unroll image columns", TypeConverters.to_boolean)
+
+    def __init__(self, feature_columns: Optional[List[str]] = None,
+                 output_col: str = "features", number_of_features: int = 4096,
+                 one_hot_encode_categoricals: bool = True, allow_images: bool = False):
+        super().__init__()
+        if feature_columns:
+            self.set(self.feature_columns, feature_columns)
+        self.set(self.output_col, output_col)
+        self.set(self.number_of_features, number_of_features)
+        self.set(self.one_hot_encode_categoricals, one_hot_encode_categoricals)
+        self.set(self.allow_images, allow_images)
+
+    def set_feature_columns(self, v: List[str]):
+        return self.set(self.feature_columns, v)
+
+    def fit(self, df: DataFrame) -> "FeaturizeModel":
+        one_hot = self.get(self.one_hot_encode_categoricals)
+        plans: List[Dict[str, Any]] = []
+        for name in self.get(self.feature_columns):
+            col = df.column(name)
+            cmap = get_categorical_map(df, name)
+            if cmap is not None:
+                plans.append({
+                    "col": name,
+                    "kind": "onehot" if one_hot else "cat_index",
+                    "levels": list(cmap.levels),
+                })
+            elif col.dtype == DataType.VECTOR:
+                plans.append({"col": name, "kind": "vector"})
+            elif col.dtype == DataType.BOOLEAN:
+                plans.append({"col": name, "kind": "bool"})
+            elif col.dtype.is_numeric:
+                v = col.values.astype(np.float64)
+                finite = v[~np.isnan(v)]
+                plans.append({
+                    "col": name, "kind": "numeric",
+                    "mean": float(finite.mean()) if len(finite) else 0.0,
+                })
+            elif col.dtype == DataType.TIMESTAMP:
+                plans.append({"col": name, "kind": "datetime"})
+            elif col.dtype == DataType.STRING:
+                values = [v for v in col.values if v is not None]
+                uniq = sorted(set(values))
+                if one_hot and len(uniq) <= 64:  # low-cardinality: one-hot
+                    plans.append({"col": name, "kind": "onehot", "levels": uniq})
+                else:
+                    plans.append({
+                        "col": name, "kind": "hash_string",
+                        "width": self.get(self.number_of_features),
+                    })
+            elif col.dtype == DataType.ARRAY:
+                plans.append({
+                    "col": name, "kind": "hash_tokens",
+                    "width": self.get(self.number_of_features),
+                })
+            elif is_image(df, name):
+                if not self.get(self.allow_images):
+                    raise ValueError(
+                        f"image column {name!r} requires allow_images=True"
+                    )
+                plans.append({"col": name, "kind": "image"})
+            else:
+                raise TypeError(
+                    f"cannot featurize column {name!r} of type {col.dtype.value}"
+                )
+        model = FeaturizeModel(plans)
+        model.set(model.output_col, self.get(self.output_col))
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.VECTOR)]
+
+
+class FeaturizeModel(Model, HasOutputCol, Wrappable):
+    plans = ComplexParam("plans", "Per-column featurization plans")
+
+    def __init__(self, plans: Optional[List[Dict[str, Any]]] = None):
+        super().__init__()
+        if plans is not None:
+            self.set(self.plans, plans)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.VECTOR)]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        from mmlspark_tpu.text.features import _stable_hash
+
+        parts: List[np.ndarray] = []
+        names: List[str] = []
+        n = len(df)
+        for plan in self.get(self.plans):
+            name = plan["col"]
+            kind = plan["kind"]
+            col = df.column(name)
+            if kind == "numeric":
+                v = col.values.astype(np.float64).copy()
+                v[np.isnan(v)] = plan["mean"]
+                parts.append(v[:, None])
+                names.append(name)
+            elif kind == "bool":
+                parts.append(col.values.astype(np.float64)[:, None])
+                names.append(name)
+            elif kind == "vector":
+                parts.append(col.values.astype(np.float64))
+                names.extend(f"{name}_{i}" for i in range(col.values.shape[1]))
+            elif kind in ("onehot", "cat_index"):
+                levels = plan["levels"]
+                index = {v: i for i, v in enumerate(levels)}
+                vals = df._hashable_col(name)
+                idx = np.array([index.get(v, -1) for v in vals], np.int64)
+                if kind == "cat_index":
+                    parts.append(idx.astype(np.float64)[:, None])
+                    names.append(name)
+                else:
+                    oh = np.zeros((n, len(levels)), np.float64)
+                    ok = idx >= 0
+                    oh[np.nonzero(ok)[0], idx[ok]] = 1.0
+                    parts.append(oh)
+                    names.extend(f"{name}={lv}" for lv in levels)
+            elif kind == "datetime":
+                ts = col.values.astype("datetime64[us]")
+                import datetime
+
+                feats = np.zeros((n, 6), np.float64)
+                for i, t in enumerate(ts):
+                    dt = t.astype(datetime.datetime)
+                    feats[i] = [dt.year, dt.month, dt.day, dt.weekday(), dt.hour, dt.minute]
+                parts.append(feats)
+                names.extend(f"{name}_{p}" for p in ("year", "month", "day", "weekday", "hour", "minute"))
+            elif kind == "hash_string":
+                width = plan["width"]
+                out = np.zeros((n, width), np.float64)
+                for i, v in enumerate(col.values):
+                    for tok in str(v).lower().split():
+                        out[i, _stable_hash(tok, width)] += 1.0
+                parts.append(out)
+                names.extend(f"{name}_hash{i}" for i in range(width))
+            elif kind == "hash_tokens":
+                width = plan["width"]
+                out = np.zeros((n, width), np.float64)
+                for i, tokens in enumerate(col.values):
+                    for tok in tokens:
+                        out[i, _stable_hash(str(tok), width)] += 1.0
+                parts.append(out)
+                names.extend(f"{name}_hash{i}" for i in range(width))
+            elif kind == "image":
+                rows = []
+                for r in col.values:
+                    data = np.asarray(r["data"])
+                    if data.ndim == 2:  # grayscale: promote to HWC like UnrollImage
+                        data = data[:, :, None]
+                    rows.append(np.transpose(data, (2, 0, 1)).reshape(-1))
+                arr = np.stack(rows).astype(np.float64)
+                parts.append(arr)
+                names.extend(f"{name}_px{i}" for i in range(arr.shape[1]))
+            else:
+                raise ValueError(f"unknown plan kind {kind!r}")
+        out = (
+            np.concatenate(parts, axis=1) if parts else np.zeros((n, 0), np.float64)
+        )
+        return df.with_column(
+            self.get(self.output_col), out, DataType.VECTOR,
+            metadata={"ml_attr": {"names": names}},
+        )

@@ -26,9 +26,8 @@ def resize(img: np.ndarray, height: int, width: int) -> np.ndarray:
     fy = np.clip(out_y - y0, 0, 1)[:, None, None]
     fx = np.clip(out_x - x0, 0, 1)[None, :, None]
     im = img.astype(np.float64)
-    if im.ndim == 2:
+    if im.ndim == 2:  # promote BEFORE interpolating so fx/fy broadcast per-pixel
         im = im[:, :, None]
-        fy, fx = fy[..., 0], fx[..., 0]
     top = im[y0][:, x0] * (1 - fx) + im[y0][:, x1] * fx
     bot = im[y1][:, x0] * (1 - fx) + im[y1][:, x1] * fx
     out = top * (1 - fy) + bot * fy

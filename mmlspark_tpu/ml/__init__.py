@@ -1,0 +1,22 @@
+"""ml — classical learners on the TPU training machinery.
+
+The reference wraps SparkML's LogisticRegression / RandomForest / GBT etc.
+inside TrainClassifier (TrainClassifier.scala:104-140). Here the classical
+tier is built on the same jit/optax loop as TPULearner: a linear model is a
+zero-hidden-layer Network, so LogisticRegression and LinearRegression get
+the mesh/data-parallel path for free. Tree ensembles come from gbdt/.
+"""
+
+from mmlspark_tpu.ml.classical import (
+    LinearRegression,
+    LinearRegressionModel,
+    LogisticRegression,
+    LogisticRegressionModel,
+)
+
+__all__ = [
+    "LinearRegression",
+    "LinearRegressionModel",
+    "LogisticRegression",
+    "LogisticRegressionModel",
+]

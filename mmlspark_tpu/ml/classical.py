@@ -1,0 +1,159 @@
+"""Linear classifiers/regressors as zero-hidden-layer TPULearner networks."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType, Field
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasFeaturesCol,
+    HasLabelCol,
+    Param,
+    TypeConverters,
+    Wrappable,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.dnn.resnet import mlp
+from mmlspark_tpu.models.tpu_learner import TPULearner
+from mmlspark_tpu.models.tpu_model import TPUModel, extract_feature_matrix
+
+
+class _LinearParams(HasFeaturesCol, HasLabelCol):
+    max_iter = Param("max_iter", "Training epochs", TypeConverters.to_int)
+    learning_rate = Param("learning_rate", "Step size", TypeConverters.to_float)
+    reg_param = Param("reg_param", "L2 regularization (weight decay)", TypeConverters.to_float)
+    batch_size = Param("batch_size", "Global batch size", TypeConverters.to_int)
+    seed = Param("seed", "PRNG seed", TypeConverters.to_int)
+    prediction_col = Param("prediction_col", "Prediction column", TypeConverters.to_string)
+
+    def _set_linear_defaults(self) -> None:
+        self._set_defaults(
+            features_col="features", label_col="label", prediction_col="prediction",
+            max_iter=50, learning_rate=0.1, reg_param=0.0, batch_size=64, seed=0,
+        )
+
+    def _learner(self, network, loss: str) -> TPULearner:
+        return TPULearner(
+            network,
+            features_col=self.get(self.features_col),
+            label_col=self.get(self.label_col),
+            loss=loss,
+            optimizer="adamw" if self.get(self.reg_param) > 0 else "adam",
+            weight_decay=self.get(self.reg_param),
+            learning_rate=self.get(self.learning_rate),
+            epochs=self.get(self.max_iter),
+            batch_size=self.get(self.batch_size),
+            seed=self.get(self.seed),
+        )
+
+
+class LogisticRegression(Estimator, _LinearParams, Wrappable):
+    """Multinomial logistic regression trained with the jit DP loop."""
+
+    raw_prediction_col = Param("raw_prediction_col", "Raw margin column", TypeConverters.to_string)
+    probability_col = Param("probability_col", "Probability column", TypeConverters.to_string)
+
+    def __init__(self, **kwargs: Any):
+        super().__init__()
+        self._set_linear_defaults()
+        self._set_defaults(raw_prediction_col="rawPrediction", probability_col="probability")
+        self.set_params(**kwargs)
+
+    def fit(self, df: DataFrame) -> "LogisticRegressionModel":
+        fcol = df.column(self.get(self.features_col))
+        d = fcol.values.shape[1] if fcol.values.ndim == 2 else 1
+        y = df[self.get(self.label_col)]
+        y_arr = np.asarray([float(v) for v in y])
+        k = max(2, int(np.nanmax(y_arr)) + 1)
+        inner = self._learner(mlp(d, [], k), "softmax_cross_entropy").fit(df)
+        model = LogisticRegressionModel(inner)
+        for p in ("features_col", "prediction_col", "raw_prediction_col", "probability_col"):
+            model.set(p, self.get(p))
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [
+            Field(self.get(self.raw_prediction_col), DataType.VECTOR),
+            Field(self.get(self.probability_col), DataType.VECTOR),
+            Field(self.get(self.prediction_col), DataType.DOUBLE),
+        ]
+
+
+class LogisticRegressionModel(Model, HasFeaturesCol, Wrappable):
+    inner = ComplexParam("inner", "Fitted TPUModel")
+    prediction_col = Param("prediction_col", "Prediction column", TypeConverters.to_string)
+    raw_prediction_col = Param("raw_prediction_col", "Raw margin column", TypeConverters.to_string)
+    probability_col = Param("probability_col", "Probability column", TypeConverters.to_string)
+
+    def __init__(self, inner: Optional[TPUModel] = None):
+        super().__init__()
+        self._set_defaults(
+            features_col="features", prediction_col="prediction",
+            raw_prediction_col="rawPrediction", probability_col="probability",
+        )
+        if inner is not None:
+            self.set(self.inner, inner)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        tpu_model: TPUModel = self.get(self.inner)
+        tpu_model.set(tpu_model.input_col, self.get(self.features_col))
+        scored = tpu_model.transform(df)
+        raw = scored[tpu_model.get(tpu_model.output_col)]
+        e = np.exp(raw - raw.max(axis=1, keepdims=True))
+        prob = e / e.sum(axis=1, keepdims=True)
+        pred = prob.argmax(axis=1).astype(np.float64)
+        out = df
+        out = out.with_column(self.get(self.raw_prediction_col), raw, DataType.VECTOR)
+        out = out.with_column(self.get(self.probability_col), prob, DataType.VECTOR)
+        return out.with_column(self.get(self.prediction_col), pred, DataType.DOUBLE)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [
+            Field(self.get(self.raw_prediction_col), DataType.VECTOR),
+            Field(self.get(self.probability_col), DataType.VECTOR),
+            Field(self.get(self.prediction_col), DataType.DOUBLE),
+        ]
+
+
+class LinearRegression(Estimator, _LinearParams, Wrappable):
+    def __init__(self, **kwargs: Any):
+        super().__init__()
+        self._set_linear_defaults()
+        self.set_params(**kwargs)
+
+    def fit(self, df: DataFrame) -> "LinearRegressionModel":
+        fcol = df.column(self.get(self.features_col))
+        d = fcol.values.shape[1] if fcol.values.ndim == 2 else 1
+        inner = self._learner(mlp(d, [], 1), "mse").fit(df)
+        model = LinearRegressionModel(inner)
+        for p in ("features_col", "prediction_col"):
+            model.set(p, self.get(p))
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.prediction_col), DataType.DOUBLE)]
+
+
+class LinearRegressionModel(Model, HasFeaturesCol, Wrappable):
+    inner = ComplexParam("inner", "Fitted TPUModel")
+    prediction_col = Param("prediction_col", "Prediction column", TypeConverters.to_string)
+
+    def __init__(self, inner: Optional[TPUModel] = None):
+        super().__init__()
+        self._set_defaults(features_col="features", prediction_col="prediction")
+        if inner is not None:
+            self.set(self.inner, inner)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        tpu_model: TPUModel = self.get(self.inner)
+        tpu_model.set(tpu_model.input_col, self.get(self.features_col))
+        scored = tpu_model.transform(df)
+        raw = scored[tpu_model.get(tpu_model.output_col)]
+        pred = raw[:, 0].astype(np.float64) if raw.ndim == 2 else raw.astype(np.float64)
+        return df.with_column(self.get(self.prediction_col), pred, DataType.DOUBLE)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.prediction_col), DataType.DOUBLE)]

@@ -10,10 +10,64 @@ from mmlspark_tpu.stages.batching import (
     FlattenBatch,
     TimeIntervalMiniBatchTransformer,
 )
+from mmlspark_tpu.stages.basic import (
+    Cacher,
+    ClassBalancer,
+    ClassBalancerModel,
+    DropColumns,
+    Explode,
+    Lambda,
+    PartitionConsolidator,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    TextPreprocessor,
+    Timer,
+    TimerModel,
+    UDFTransformer,
+)
+from mmlspark_tpu.stages.dataprep import (
+    CheckpointData,
+    CleanMissingData,
+    CleanMissingDataModel,
+    DataConversion,
+    EnsembleByKey,
+    IndexToValue,
+    MultiColumnAdapter,
+    PartitionSample,
+    SummarizeData,
+    ValueIndexer,
+    ValueIndexerModel,
+)
 
 __all__ = [
+    "Cacher",
+    "CheckpointData",
+    "ClassBalancer",
+    "ClassBalancerModel",
+    "CleanMissingData",
+    "CleanMissingDataModel",
+    "DataConversion",
+    "DropColumns",
     "DynamicMiniBatchTransformer",
+    "EnsembleByKey",
+    "Explode",
     "FixedMiniBatchTransformer",
     "FlattenBatch",
+    "IndexToValue",
+    "Lambda",
+    "MultiColumnAdapter",
+    "PartitionConsolidator",
+    "PartitionSample",
+    "RenameColumn",
+    "Repartition",
+    "SelectColumns",
+    "SummarizeData",
+    "TextPreprocessor",
     "TimeIntervalMiniBatchTransformer",
+    "Timer",
+    "TimerModel",
+    "UDFTransformer",
+    "ValueIndexer",
+    "ValueIndexerModel",
 ]

@@ -1,0 +1,366 @@
+"""Utility df->df pipeline stages.
+
+Reference: pipeline-stages/src/main/scala/*.scala (SURVEY.md §2.4) —
+DropColumns, SelectColumns, RenameColumn, Repartition, Explode, Lambda
+(Lambda.scala:20), Timer (Timer.scala:55), UDFTransformer
+(UDFTransformer.scala:21), Cacher, ClassBalancer (ClassBalancer.scala:25),
+TextPreprocessor (trie find/replace), PartitionConsolidator
+(PartitionConsolidator.scala:15-127).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType, Field
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    TypeConverters,
+    Wrappable,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model, PipelineStage, Transformer
+
+
+class DropColumns(Transformer, Wrappable):
+    cols = Param("cols", "Comma separated list of column names", TypeConverters.to_list_string)
+
+    def __init__(self, cols: Optional[List[str]] = None):
+        super().__init__()
+        if cols is not None:
+            self.set(self.cols, cols)
+
+    def set_cols(self, v: List[str]):
+        return self.set(self.cols, v)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        drop = set(self.get(self.cols))
+        return [f for f in schema if f.name not in drop]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df.drop(*self.get(self.cols))
+
+
+class SelectColumns(Transformer, Wrappable):
+    cols = Param("cols", "Comma separated list of selected column names", TypeConverters.to_list_string)
+
+    def __init__(self, cols: Optional[List[str]] = None):
+        super().__init__()
+        if cols is not None:
+            self.set(self.cols, cols)
+
+    def set_cols(self, v: List[str]):
+        return self.set(self.cols, v)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        keep = self.get(self.cols)
+        by_name = {f.name: f for f in schema}
+        return [by_name[n] for n in keep]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df.select(*self.get(self.cols))
+
+
+class RenameColumn(Transformer, HasInputCol, HasOutputCol, Wrappable):
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None):
+        super().__init__()
+        if input_col:
+            self.set(self.input_col, input_col)
+        if output_col:
+            self.set(self.output_col, output_col)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        old, new = self.get(self.input_col), self.get(self.output_col)
+        return [Field(new if f.name == old else f.name, f.dtype, f.metadata) for f in schema]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df.rename(self.get(self.input_col), self.get(self.output_col))
+
+
+class Repartition(Transformer, Wrappable):
+    n = Param("n", "Number of partitions", TypeConverters.to_int)
+    disable = Param("disable", "Pass through without repartitioning", TypeConverters.to_boolean)
+
+    def __init__(self, n: int = 1, disable: bool = False):
+        super().__init__()
+        self.set(self.n, n)
+        self.set(self.disable, disable)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        if self.get(self.disable):
+            return df
+        return df.repartition(self.get(self.n))
+
+
+class Explode(Transformer, HasInputCol, HasOutputCol, Wrappable):
+    """Explode an ARRAY column into one row per element."""
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None):
+        super().__init__()
+        if input_col:
+            self.set(self.input_col, input_col)
+        if output_col:
+            self.set(self.output_col, output_col)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        out = self.get_or_default(self.output_col, self.get(self.input_col))
+        if all(f.name != out for f in schema):
+            return schema + [Field(out, DataType.STRING)]
+        return schema
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        in_col = self.get(self.input_col)
+        out_col = self.get_or_default(self.output_col, in_col)
+        values = df[in_col]
+        lens = [len(v) if v is not None else 0 for v in values]
+        idx = np.repeat(np.arange(len(df)), lens)
+        exploded: List[Any] = []
+        for v in values:
+            if v is not None:
+                exploded.extend(list(v))
+        base = df.filter(idx)
+        return base.with_column(out_col, Column(exploded))
+
+
+class Lambda(Transformer, Wrappable):
+    """Arbitrary DataFrame -> DataFrame function as a stage (reference:
+    Lambda.scala:20, transformFunc/transformSchemaFunc UDFParams).
+    Persistence uses pickle (document: trusted input only)."""
+
+    transform_func = ComplexParam("transform_func", "df -> df callable")
+    transform_schema_func = ComplexParam("transform_schema_func", "schema -> schema callable")
+
+    def __init__(self, transform_func: Optional[Callable] = None,
+                 transform_schema_func: Optional[Callable] = None):
+        super().__init__()
+        if transform_func is not None:
+            self.set(self.transform_func, transform_func)
+        if transform_schema_func is not None:
+            self.set(self.transform_schema_func, transform_schema_func)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        if self.is_defined(self.transform_schema_func):
+            return self.get(self.transform_schema_func)(schema)
+        return schema
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return self.get(self.transform_func)(df)
+
+
+class Timer(Estimator, Wrappable):
+    """Wrap a stage; log wall-clock of fit/transform (Timer.scala:55-124)."""
+
+    stage = ComplexParam("stage", "The stage to time")
+    log_to_scala = Param("log_to_scala", "Log to the framework logger (vs return string)", TypeConverters.to_boolean)
+    disable_materialization = Param(
+        "disable_materialization", "Skip forcing materialization", TypeConverters.to_boolean
+    )
+
+    def __init__(self, stage: Optional[PipelineStage] = None, **kwargs: Any):
+        super().__init__()
+        self._set_defaults(log_to_scala=True, disable_materialization=True)
+        if stage is not None:
+            self.set(self.stage, stage)
+        self.set_params(**kwargs)
+
+    def _log(self, msg: str) -> None:
+        get_logger("mmlspark_tpu.timer").info(msg)
+
+    def fit(self, df: DataFrame) -> "TimerModel":
+        inner = self.get(self.stage)
+        if isinstance(inner, Estimator):
+            t0 = time.time()
+            fitted = inner.fit(df)
+            self._log(f"{type(inner).__name__}.fit took {time.time() - t0:.3f}s")
+        else:
+            fitted = inner
+        return TimerModel(fitted)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return self.get(self.stage).transform_schema(schema)
+
+
+class TimerModel(Model, Wrappable):
+    stage = ComplexParam("stage", "The timed transformer")
+
+    def __init__(self, stage: Optional[Transformer] = None):
+        super().__init__()
+        if stage is not None:
+            self.set(self.stage, stage)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        inner = self.get(self.stage)
+        t0 = time.time()
+        out = inner.transform(df)
+        get_logger("mmlspark_tpu.timer").info(
+            f"{type(inner).__name__}.transform took {time.time() - t0:.3f}s"
+        )
+        return out
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return self.get(self.stage).transform_schema(schema)
+
+
+class UDFTransformer(Transformer, HasInputCol, HasOutputCol, Wrappable):
+    """Apply a per-row (or whole-column) function to produce a new column
+    (UDFTransformer.scala:21). `udf` gets one row value; `vector_udf` gets
+    the whole numpy column for vectorized application."""
+
+    input_cols = Param("input_cols", "The names of the input columns", TypeConverters.to_list_string)
+    udf = ComplexParam("udf", "per-row callable")
+    vector_udf = ComplexParam("vector_udf", "whole-column callable")
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
+                 udf: Optional[Callable] = None, vector_udf: Optional[Callable] = None,
+                 input_cols: Optional[List[str]] = None):
+        super().__init__()
+        if input_col:
+            self.set(self.input_col, input_col)
+        if input_cols:
+            self.set(self.input_cols, input_cols)
+        if output_col:
+            self.set(self.output_col, output_col)
+        if udf is not None:
+            self.set(self.udf, udf)
+        if vector_udf is not None:
+            self.set(self.vector_udf, vector_udf)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.STRING)]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        out_col = self.get(self.output_col)
+        if self.is_set(self.vector_udf):
+            fn = self.get(self.vector_udf)
+            if self.is_set(self.input_cols):
+                out = fn(*[df[c] for c in self.get(self.input_cols)])
+            else:
+                out = fn(df[self.get(self.input_col)])
+            return df.with_column(out_col, out)
+        fn = self.get(self.udf)
+        if self.is_set(self.input_cols):
+            cols = [df[c] for c in self.get(self.input_cols)]
+            out = [fn(*vals) for vals in zip(*cols)]
+        else:
+            out = [fn(v) for v in df[self.get(self.input_col)]]
+        return df.with_column(out_col, out)
+
+
+class Cacher(Transformer, Wrappable):
+    """Cache the DataFrame (Cacher.scala). The eager engine is always
+    materialized; kept for pipeline parity."""
+
+    disable = Param("disable", "Whether or not to cache", TypeConverters.to_boolean)
+
+    def __init__(self, disable: bool = False):
+        super().__init__()
+        self.set(self.disable, disable)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df if self.get(self.disable) else df.cache()
+
+
+class ClassBalancer(Estimator, HasInputCol, HasOutputCol, Wrappable):
+    """Weight column = max_class_count / class_count per label value
+    (ClassBalancer.scala:25)."""
+
+    def __init__(self, input_col: str = "label", output_col: str = "weight"):
+        super().__init__()
+        self.set(self.input_col, input_col)
+        self.set(self.output_col, output_col)
+
+    def fit(self, df: DataFrame) -> "ClassBalancerModel":
+        values = df._hashable_col(self.get(self.input_col))
+        counts: Dict[Any, int] = {}
+        for v in values:
+            counts[v] = counts.get(v, 0) + 1
+        top = max(counts.values())
+        weights = {k: top / c for k, c in counts.items()}
+        model = ClassBalancerModel(weights)
+        model.set(model.input_col, self.get(self.input_col))
+        model.set(model.output_col, self.get(self.output_col))
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.DOUBLE)]
+
+
+class ClassBalancerModel(Model, HasInputCol, HasOutputCol, Wrappable):
+    weights = ComplexParam("weights", "label value -> weight mapping")
+
+    def __init__(self, weights: Optional[Dict[Any, float]] = None):
+        super().__init__()
+        if weights is not None:
+            self.set(self.weights, weights)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        weights = self.get(self.weights)
+        values = df._hashable_col(self.get(self.input_col))
+        out = np.array([weights.get(v, 1.0) for v in values], np.float64)
+        return df.with_column(self.get(self.output_col), out, DataType.DOUBLE)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.DOUBLE)]
+
+
+class TextPreprocessor(Transformer, HasInputCol, HasOutputCol, Wrappable):
+    """Longest-match find/replace over a substitution map, with optional
+    normalization (reference TextPreprocessor's trie semantics)."""
+
+    map_param = Param("map", "substring -> replacement map", TypeConverters.to_dict)
+    normalize_case = Param("normalize_case", "Lowercase before matching", TypeConverters.to_boolean)
+
+    def __init__(self, map: Optional[Dict[str, str]] = None,
+                 input_col: Optional[str] = None, output_col: Optional[str] = None,
+                 normalize_case: bool = True):
+        super().__init__()
+        self.set(self.map_param, map or {})
+        self.set(self.normalize_case, normalize_case)
+        if input_col:
+            self.set(self.input_col, input_col)
+        if output_col:
+            self.set(self.output_col, output_col)
+
+    def _process(self, text: str, subs: Dict[str, str]) -> str:
+        if self.get(self.normalize_case):
+            text = text.lower()
+            subs = {k.lower(): v for k, v in subs.items()}
+        keys = sorted(subs, key=len, reverse=True)  # longest match first
+        out = []
+        i = 0
+        while i < len(text):
+            for key in keys:
+                if key and text.startswith(key, i):
+                    out.append(subs[key])
+                    i += len(key)
+                    break
+            else:
+                out.append(text[i])
+                i += 1
+        return "".join(out)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.STRING)]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        subs = self.get(self.map_param)
+        out = [self._process(str(v), subs) for v in df[self.get(self.input_col)]]
+        return df.with_column(self.get(self.output_col), out, DataType.STRING)
+
+
+class PartitionConsolidator(Transformer, Wrappable):
+    """Funnel all partitions' rows through one logical worker — used for
+    rate-limited resources (PartitionConsolidator.scala:15-127). In the
+    eager engine this is exactly coalesce(1) while preserving row order."""
+
+    def __init__(self):
+        super().__init__()
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df.repartition(1)

@@ -1,0 +1,487 @@
+"""Data-preparation stages (SURVEY.md §2.5 modules, one class per module):
+
+CleanMissingData (clean-missing-data/CleanMissingData.scala:46),
+ValueIndexer / ValueIndexerModel / IndexToValue (value-indexer/
+ValueIndexer.scala:54,:100, IndexToValue.scala:26),
+DataConversion (data-conversion/DataConversion.scala:23),
+SummarizeData (summarize-data/SummarizeData.scala:99),
+PartitionSample (partition-sample/PartitionSample.scala:137),
+MultiColumnAdapter (multi-column-adapter/MultiColumnAdapter.scala:17),
+EnsembleByKey (ensemble/EnsembleByKey.scala:21),
+CheckpointData (checkpoint-data/CheckpointData.scala:49).
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType, Field, concat
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasInputCol,
+    HasInputCols,
+    HasOutputCol,
+    HasOutputCols,
+    Param,
+    TypeConverters,
+    Wrappable,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model, PipelineStage, Transformer
+from mmlspark_tpu.core.schema import CATEGORICAL_KEY, CategoricalMap
+
+
+class CleanMissingData(Estimator, HasInputCols, HasOutputCols, Wrappable):
+    """Imputation estimator: mean | median | custom per column
+    (CleanMissingData.scala:46)."""
+
+    MEAN, MEDIAN, CUSTOM = "Mean", "Median", "Custom"
+
+    cleaning_mode = Param("cleaning_mode", "Mean | Median | Custom", TypeConverters.to_string)
+    custom_value = Param("custom_value", "Custom fill value", TypeConverters.to_float)
+
+    def __init__(self, input_cols: Optional[List[str]] = None,
+                 output_cols: Optional[List[str]] = None,
+                 cleaning_mode: str = "Mean", custom_value: Optional[float] = None):
+        super().__init__()
+        self._set_defaults(cleaning_mode="Mean")
+        if input_cols:
+            self.set(self.input_cols, input_cols)
+        if output_cols:
+            self.set(self.output_cols, output_cols)
+        self.set(self.cleaning_mode, cleaning_mode)
+        if custom_value is not None:
+            self.set(self.custom_value, custom_value)
+
+    def fit(self, df: DataFrame) -> "CleanMissingDataModel":
+        mode = self.get(self.cleaning_mode)
+        fills: Dict[str, float] = {}
+        for col_name in self.get(self.input_cols):
+            v = df[col_name].astype(np.float64)
+            finite = v[~np.isnan(v)]
+            if mode == self.MEAN:
+                fills[col_name] = float(finite.mean()) if len(finite) else 0.0
+            elif mode == self.MEDIAN:
+                fills[col_name] = float(np.median(finite)) if len(finite) else 0.0
+            elif mode == self.CUSTOM:
+                fills[col_name] = float(self.get(self.custom_value))
+            else:
+                raise ValueError(f"unknown cleaning mode {mode!r}")
+        model = CleanMissingDataModel(fills)
+        model.set(model.input_cols, self.get(self.input_cols))
+        model.set(model.output_cols, self.get(self.output_cols))
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        extra = [
+            Field(o, DataType.DOUBLE)
+            for o in self.get(self.output_cols)
+            if all(f.name != o for f in schema)
+        ]
+        return schema + extra
+
+
+class CleanMissingDataModel(Model, HasInputCols, HasOutputCols, Wrappable):
+    fill_values = ComplexParam("fill_values", "column -> fill value")
+
+    def __init__(self, fill_values: Optional[Dict[str, float]] = None):
+        super().__init__()
+        if fill_values is not None:
+            self.set(self.fill_values, fill_values)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        fills = self.get(self.fill_values)
+        out = df
+        for in_col, out_col in zip(self.get(self.input_cols), self.get(self.output_cols)):
+            v = df[in_col].astype(np.float64).copy()
+            v[np.isnan(v)] = fills[in_col]
+            out = out.with_column(out_col, v, DataType.DOUBLE)
+        return out
+
+
+class ValueIndexer(Estimator, HasInputCol, HasOutputCol, Wrappable):
+    """Index distinct values -> doubles with categorical metadata, keeping
+    the level's original type (ValueIndexer.scala:54)."""
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None):
+        super().__init__()
+        if input_col:
+            self.set(self.input_col, input_col)
+        if output_col:
+            self.set(self.output_col, output_col)
+
+    def fit(self, df: DataFrame) -> "ValueIndexerModel":
+        values = df._hashable_col(self.get(self.input_col))
+        non_null = [v for v in values if v is not None]
+        try:
+            levels = sorted(set(non_null))
+        except TypeError:
+            levels = list(dict.fromkeys(non_null))
+        model = ValueIndexerModel(levels)
+        model.set(model.input_col, self.get(self.input_col))
+        model.set(model.output_col, self.get(self.output_col))
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.DOUBLE)]
+
+
+class ValueIndexerModel(Model, HasInputCol, HasOutputCol, Wrappable):
+    levels = ComplexParam("levels", "Ordered distinct level values")
+
+    def __init__(self, levels: Optional[List[Any]] = None):
+        super().__init__()
+        if levels is not None:
+            self.set(self.levels, list(levels))
+
+    def get_levels(self) -> List[Any]:
+        return self.get(self.levels)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        cmap = CategoricalMap(self.get(self.levels))
+        values = df._hashable_col(self.get(self.input_col))
+        idx = np.array(
+            [float(cmap.get_index_option(v, -1)) for v in values], np.float64
+        )
+        if (idx < 0).any():
+            bad = next(v for v in values if cmap.get_index_option(v, -1) < 0)
+            raise ValueError(f"unseen value {bad!r} in {self.get(self.input_col)!r}")
+        return df.with_column(
+            self.get(self.output_col), idx, DataType.DOUBLE,
+            metadata=cmap.to_metadata(),
+        )
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.DOUBLE)]
+
+
+class IndexToValue(Transformer, HasInputCol, HasOutputCol, Wrappable):
+    """Inverse of ValueIndexerModel using the column's categorical metadata
+    (IndexToValue.scala:26)."""
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None):
+        super().__init__()
+        if input_col:
+            self.set(self.input_col, input_col)
+        if output_col:
+            self.set(self.output_col, output_col)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        meta = df.metadata(self.get(self.input_col))
+        cmap = CategoricalMap.from_metadata(meta)
+        if cmap is None:
+            raise ValueError(
+                f"column {self.get(self.input_col)!r} has no categorical metadata"
+            )
+        idx = df[self.get(self.input_col)]
+        out = [cmap.get_level(int(i)) for i in idx]
+        return df.with_column(self.get(self.output_col), out)
+
+
+class DataConversion(Transformer, Wrappable):
+    """Column type casting (DataConversion.scala:23). convert_to: boolean |
+    byte | short | integer | long | float | double | string | toCategorical |
+    clearCategorical | date."""
+
+    cols = Param("cols", "Columns to convert", TypeConverters.to_list_string)
+    convert_to = Param("convert_to", "Target type", TypeConverters.to_string)
+    date_time_format = Param("date_time_format", "strftime format for date conversion", TypeConverters.to_string)
+
+    _CASTS = {
+        "boolean": (np.bool_, DataType.BOOLEAN),
+        "byte": (np.int32, DataType.INT),
+        "short": (np.int32, DataType.INT),
+        "integer": (np.int32, DataType.INT),
+        "long": (np.int64, DataType.LONG),
+        "float": (np.float32, DataType.FLOAT),
+        "double": (np.float64, DataType.DOUBLE),
+    }
+
+    def __init__(self, cols: Optional[List[str]] = None, convert_to: str = "double",
+                 date_time_format: str = "%Y-%m-%d %H:%M:%S"):
+        super().__init__()
+        if cols:
+            self.set(self.cols, cols)
+        self.set(self.convert_to, convert_to)
+        self.set(self.date_time_format, date_time_format)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        target = self.get(self.convert_to)
+        out = df
+        for name in self.get(self.cols):
+            col = out.column(name)
+            if target == "string":
+                vals = [str(v) for v in col.values]
+                out = out.with_column(name, Column(np.array(vals, object), DataType.STRING))
+            elif target == "toCategorical":
+                from mmlspark_tpu.stages.dataprep import ValueIndexer
+
+                model = ValueIndexer(name, name + "__tmp__").fit(out)
+                converted = model.transform(out)
+                converted = converted.drop(name).rename(name + "__tmp__", name)
+                out = converted
+            elif target == "clearCategorical":
+                meta = {k: v for k, v in col.metadata.items() if k != CATEGORICAL_KEY}
+                out = out.with_metadata(name, meta)
+            elif target == "date":
+                fmt = self.get(self.date_time_format)
+                import datetime
+
+                vals = np.array(
+                    [
+                        np.datetime64(datetime.datetime.strptime(str(v), fmt))
+                        for v in col.values
+                    ],
+                    dtype="datetime64[us]",
+                )
+                out = out.with_column(name, Column(vals, DataType.TIMESTAMP))
+            elif target in self._CASTS:
+                np_t, dt = self._CASTS[target]
+                v = col.values
+                if v.dtype == object:
+                    v = np.array([float(x) for x in v])
+                out = out.with_column(name, Column(v.astype(np_t), dt))
+            else:
+                raise ValueError(f"unknown convert_to {target!r}")
+        return out
+
+
+class SummarizeData(Transformer, Wrappable):
+    """Statistics summary as a DataFrame, one row per column
+    (SummarizeData.scala:99): counts / basic / sample / percentiles blocks."""
+
+    counts = Param("counts", "Include count statistics", TypeConverters.to_boolean)
+    basic = Param("basic", "Include basic statistics", TypeConverters.to_boolean)
+    sample = Param("sample", "Include sample statistics", TypeConverters.to_boolean)
+    percentiles = Param("percentiles", "Include percentiles", TypeConverters.to_boolean)
+
+    def __init__(self, counts: bool = True, basic: bool = True,
+                 sample: bool = True, percentiles: bool = True):
+        super().__init__()
+        self.set(self.counts, counts)
+        self.set(self.basic, basic)
+        self.set(self.sample, sample)
+        self.set(self.percentiles, percentiles)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        rows = []
+        n = len(df)
+        for field in df.schema:
+            col = df.column(field.name)
+            row: Dict[str, Any] = {"Feature": field.name}
+            is_num = field.dtype.is_numeric and col.values.dtype != object
+            v = col.values.astype(np.float64) if is_num else None
+            finite = v[~np.isnan(v)] if v is not None else None
+            if self.get(self.counts):
+                row["Count"] = float(n)
+                if v is not None:
+                    row["Unique Value Count"] = float(len(np.unique(finite)))
+                    row["Missing Value Count"] = float(np.isnan(v).sum())
+                else:
+                    vals = df._hashable_col(field.name)
+                    row["Unique Value Count"] = float(len(set(vals)))
+                    row["Missing Value Count"] = float(sum(x is None for x in vals))
+            if self.get(self.basic):
+                row["Mean"] = float(finite.mean()) if is_num and len(finite) else np.nan
+                row["Standard Deviation"] = (
+                    float(finite.std(ddof=1)) if is_num and len(finite) > 1 else np.nan
+                )
+                row["Min"] = float(finite.min()) if is_num and len(finite) else np.nan
+                row["Max"] = float(finite.max()) if is_num and len(finite) else np.nan
+            if self.get(self.sample):
+                row["Variance"] = (
+                    float(finite.var(ddof=1)) if is_num and len(finite) > 1 else np.nan
+                )
+                if is_num and len(finite) > 2:
+                    mu, sd = finite.mean(), finite.std()
+                    row["Skewness"] = float(((finite - mu) ** 3).mean() / sd ** 3) if sd else np.nan
+                    row["Kurtosis"] = float(((finite - mu) ** 4).mean() / sd ** 4 - 3) if sd else np.nan
+                else:
+                    row["Skewness"] = np.nan
+                    row["Kurtosis"] = np.nan
+            if self.get(self.percentiles):
+                for q, label in [(0.005, "P0.5"), (0.25, "P25"), (0.5, "Median"),
+                                 (0.75, "P75"), (0.995, "P99.5")]:
+                    row[label] = (
+                        float(np.quantile(finite, q)) if is_num and len(finite) else np.nan
+                    )
+            rows.append(row)
+        return DataFrame.from_rows(rows)
+
+
+class PartitionSample(Transformer, Wrappable):
+    """head | randomSample (absolute/percentage) | assignToPartition
+    (PartitionSample.scala:137)."""
+
+    mode = Param("mode", "Head | RandomSample | AssignToPartition", TypeConverters.to_string)
+    count = Param("count", "Row count for Head / absolute sample", TypeConverters.to_int)
+    percent = Param("percent", "Fraction for percentage sample", TypeConverters.to_float)
+    rs_mode = Param("rs_mode", "RandomSample mode: Absolute | Percentage", TypeConverters.to_string)
+    seed = Param("seed", "RNG seed", TypeConverters.to_int)
+    num_parts = Param("num_parts", "Partition count for AssignToPartition", TypeConverters.to_int)
+    new_col_name = Param("new_col_name", "Partition column name", TypeConverters.to_string)
+
+    def __init__(self, mode: str = "RandomSample", **kwargs: Any):
+        super().__init__()
+        self._set_defaults(
+            mode="RandomSample", count=1000, percent=0.1, rs_mode="Percentage",
+            seed=0, num_parts=10, new_col_name="Partition",
+        )
+        self.set(self.mode, mode)
+        self.set_params(**kwargs)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        mode = self.get(self.mode)
+        if mode == "Head":
+            return df.limit(self.get(self.count))
+        if mode == "RandomSample":
+            if self.get(self.rs_mode) == "Absolute":
+                frac = min(1.0, self.get(self.count) / max(1, len(df)))
+            else:
+                frac = self.get(self.percent)
+            return df.sample(frac, seed=self.get(self.seed))
+        if mode == "AssignToPartition":
+            rng = np.random.default_rng(self.get(self.seed))
+            assignment = rng.integers(0, self.get(self.num_parts), len(df))
+            return df.with_column(
+                self.get(self.new_col_name), assignment.astype(np.int32), DataType.INT
+            )
+        raise ValueError(f"unknown mode {mode!r}")
+
+
+class MultiColumnAdapter(Estimator, HasInputCols, HasOutputCols, Wrappable):
+    """Apply a single-column stage across parallel input/output column lists
+    (MultiColumnAdapter.scala:17)."""
+
+    base_stage = ComplexParam("base_stage", "Single-column stage to replicate")
+
+    def __init__(self, base_stage: Optional[PipelineStage] = None,
+                 input_cols: Optional[List[str]] = None,
+                 output_cols: Optional[List[str]] = None):
+        super().__init__()
+        if base_stage is not None:
+            self.set(self.base_stage, base_stage)
+        if input_cols:
+            self.set(self.input_cols, input_cols)
+        if output_cols:
+            self.set(self.output_cols, output_cols)
+
+    def _clones(self) -> List[PipelineStage]:
+        ins, outs = self.get(self.input_cols), self.get(self.output_cols)
+        if len(ins) != len(outs):
+            raise ValueError("input_cols and output_cols must have equal length")
+        base = self.get(self.base_stage)
+        clones = []
+        for i, o in zip(ins, outs):
+            clone = _copy.deepcopy(base)
+            clone.set("input_col", i)
+            clone.set("output_col", o)
+            clones.append(clone)
+        return clones
+
+    def fit(self, df: DataFrame) -> "Model":
+        from mmlspark_tpu.core.pipeline import PipelineModel
+
+        fitted: List[Transformer] = []
+        for clone in self._clones():
+            if isinstance(clone, Estimator):
+                fitted.append(clone.fit(df))
+            else:
+                fitted.append(clone)
+        return PipelineModel(fitted)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        for clone in self._clones():
+            schema = clone.transform_schema(schema)
+        return schema
+
+
+class EnsembleByKey(Transformer, Wrappable):
+    """Group rows by key columns and average (or collect) value columns;
+    vectors average elementwise (EnsembleByKey.scala:21)."""
+
+    keys = Param("keys", "Key columns", TypeConverters.to_list_string)
+    cols = Param("cols", "Value columns to ensemble", TypeConverters.to_list_string)
+    col_names = Param("col_names", "Output column names", TypeConverters.to_list_string)
+    strategy = Param("strategy", "Aggregation strategy: mean", TypeConverters.to_string)
+    collapse_group = Param("collapse_group", "One row per key (vs broadcast back)", TypeConverters.to_boolean)
+
+    def __init__(self, keys: Optional[List[str]] = None, cols: Optional[List[str]] = None,
+                 col_names: Optional[List[str]] = None, strategy: str = "mean",
+                 collapse_group: bool = True):
+        super().__init__()
+        self._set_defaults(strategy="mean", collapse_group=True)
+        if keys:
+            self.set(self.keys, keys)
+        if cols:
+            self.set(self.cols, cols)
+        if col_names:
+            self.set(self.col_names, col_names)
+        self.set(self.strategy, strategy)
+        self.set(self.collapse_group, collapse_group)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        if self.get(self.strategy) != "mean":
+            raise ValueError("only 'mean' strategy is supported (reference parity)")
+        keys = self.get(self.keys)
+        cols = self.get(self.cols)
+        names = (
+            self.get(self.col_names)
+            if self.is_set(self.col_names)
+            else [f"mean({c})" for c in cols]
+        )
+        key_vals = list(zip(*(df._hashable_col(k) for k in keys)))
+        groups: Dict[Any, List[int]] = {}
+        for i, kv in enumerate(key_vals):
+            groups.setdefault(kv, []).append(i)
+        out_rows: Dict[str, list] = {k: [] for k in keys}
+        for name in names:
+            out_rows[name] = []
+        key_to_mean: Dict[Any, Dict[str, Any]] = {}
+        for kv, idx in groups.items():
+            for kname, kval in zip(keys, kv):
+                out_rows[kname].append(kval)
+            means = {}
+            for c, name in zip(cols, names):
+                vals = df[c][np.asarray(idx)]
+                m = vals.mean(axis=0)
+                means[name] = m
+                out_rows[name].append(m)
+            key_to_mean[kv] = means
+        if self.get(self.collapse_group):
+            return DataFrame.from_dict(out_rows, df.num_partitions)
+        out = df
+        for c, name in zip(cols, names):
+            vals = [key_to_mean[kv][name] for kv in key_vals]
+            out = out.with_column(name, vals)
+        return out
+
+
+class CheckpointData(Transformer, Wrappable):
+    """Persist the DataFrame (cache / disk) as a stage
+    (CheckpointData.scala:49). The eager engine holds data materialized in
+    host memory already; disk mode snapshots to a temp dir so downstream
+    mutation-by-convention can't corrupt lineage."""
+
+    disk_included = Param("disk_included", "Persist to disk too", TypeConverters.to_boolean)
+    remove_checkpoint = Param("remove_checkpoint", "Unpersist instead", TypeConverters.to_boolean)
+
+    def __init__(self, disk_included: bool = False, remove_checkpoint: bool = False):
+        super().__init__()
+        self.set(self.disk_included, disk_included)
+        self.set(self.remove_checkpoint, remove_checkpoint)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        if self.get(self.remove_checkpoint):
+            return df
+        if self.get(self.disk_included):
+            import tempfile
+
+            from mmlspark_tpu.core.serialize import load_dataframe, save_dataframe
+
+            d = tempfile.mkdtemp(prefix="mmlspark_tpu_ckpt_")
+            save_dataframe(df, d)
+            return load_dataframe(d)
+        return df.cache()

@@ -1,0 +1,35 @@
+"""text — tokenization and text featurization stages.
+
+Equivalent of the reference's text-featurizer module (SURVEY.md §2.3,
+TextFeaturizer.scala:179) plus the SparkML primitives it composes
+(Tokenizer, StopWordsRemover, NGram, HashingTF, IDF).
+
+Dense-data-plane note: Spark's HashingTF emits 2^18-dim sparse vectors; a
+dense TPU tensor that wide is waste, so the default here is the reference's
+tree/NN featurization width (2^12, Featurize.scala:13-19). Raise
+num_features if hash collisions matter more than memory.
+"""
+
+from mmlspark_tpu.text.features import (
+    HashingTF,
+    IDF,
+    IDFModel,
+    NGram,
+    RegexTokenizer,
+    StopWordsRemover,
+    TextFeaturizer,
+    TextFeaturizerModel,
+    Tokenizer,
+)
+
+__all__ = [
+    "HashingTF",
+    "IDF",
+    "IDFModel",
+    "NGram",
+    "RegexTokenizer",
+    "StopWordsRemover",
+    "TextFeaturizer",
+    "TextFeaturizerModel",
+    "Tokenizer",
+]

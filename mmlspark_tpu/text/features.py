@@ -1,0 +1,329 @@
+"""Text feature stages: tokenize -> stopwords -> ngrams -> TF(-IDF).
+
+Reference: text-featurizer/src/main/scala/TextFeaturizer.scala (the
+composed Estimator, :179) and the SparkML stages it wires. Hashing uses
+Python's stable md5 (not id-based hash()) so vectors are reproducible
+across processes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Any, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType, Field
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    TypeConverters,
+    Wrappable,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+
+# SparkML's english stop word list (abridged, public domain)
+ENGLISH_STOP_WORDS = """a about above after again against all am an and any are as at be because
+been before being below between both but by could did do does doing down during each few for from
+further had has have having he her here hers herself him himself his how i if in into is it its
+itself just me more most my myself no nor not now of off on once only or other our ours ourselves
+out over own same she should so some such than that the their theirs them themselves then there
+these they this those through to too under until up very was we were what when where which while
+who whom why will with you your yours yourself yourselves""".split()
+
+
+def _stable_hash(token: str, buckets: int) -> int:
+    digest = hashlib.md5(token.encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "little") % buckets
+
+
+class Tokenizer(Transformer, HasInputCol, HasOutputCol, Wrappable):
+    """Lowercase whitespace tokenizer (SparkML Tokenizer semantics)."""
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None):
+        super().__init__()
+        if input_col:
+            self.set(self.input_col, input_col)
+        if output_col:
+            self.set(self.output_col, output_col)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.ARRAY)]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        out = np.empty(len(df), dtype=object)
+        for i, v in enumerate(df[self.get(self.input_col)]):
+            out[i] = str(v).lower().split()
+        return df.with_column(self.get(self.output_col), Column(out, DataType.ARRAY))
+
+
+class RegexTokenizer(Transformer, HasInputCol, HasOutputCol, Wrappable):
+    pattern = Param("pattern", "Regex (split pattern if gaps else match pattern)", TypeConverters.to_string)
+    gaps = Param("gaps", "True: pattern matches gaps; False: matches tokens", TypeConverters.to_boolean)
+    to_lowercase = Param("to_lowercase", "Lowercase first", TypeConverters.to_boolean)
+    min_token_length = Param("min_token_length", "Drop shorter tokens", TypeConverters.to_int)
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
+                 pattern: str = r"\s+", gaps: bool = True, to_lowercase: bool = True,
+                 min_token_length: int = 1):
+        super().__init__()
+        if input_col:
+            self.set(self.input_col, input_col)
+        if output_col:
+            self.set(self.output_col, output_col)
+        self.set(self.pattern, pattern)
+        self.set(self.gaps, gaps)
+        self.set(self.to_lowercase, to_lowercase)
+        self.set(self.min_token_length, min_token_length)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.ARRAY)]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        pat = re.compile(self.get(self.pattern))
+        min_len = self.get(self.min_token_length)
+        out = np.empty(len(df), dtype=object)
+        for i, v in enumerate(df[self.get(self.input_col)]):
+            text = str(v)
+            if self.get(self.to_lowercase):
+                text = text.lower()
+            tokens = pat.split(text) if self.get(self.gaps) else pat.findall(text)
+            out[i] = [t for t in tokens if len(t) >= min_len]
+        return df.with_column(self.get(self.output_col), Column(out, DataType.ARRAY))
+
+
+class StopWordsRemover(Transformer, HasInputCol, HasOutputCol, Wrappable):
+    stop_words = Param("stop_words", "Words to filter out", TypeConverters.to_list_string)
+    case_sensitive = Param("case_sensitive", "Case sensitive matching", TypeConverters.to_boolean)
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
+                 stop_words: Optional[List[str]] = None, case_sensitive: bool = False):
+        super().__init__()
+        if input_col:
+            self.set(self.input_col, input_col)
+        if output_col:
+            self.set(self.output_col, output_col)
+        self.set(self.stop_words, stop_words or ENGLISH_STOP_WORDS)
+        self.set(self.case_sensitive, case_sensitive)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.ARRAY)]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        cs = self.get(self.case_sensitive)
+        stops = set(
+            w if cs else w.lower() for w in self.get(self.stop_words)
+        )
+        out = np.empty(len(df), dtype=object)
+        for i, tokens in enumerate(df[self.get(self.input_col)]):
+            out[i] = [
+                t for t in tokens if (t if cs else str(t).lower()) not in stops
+            ]
+        return df.with_column(self.get(self.output_col), Column(out, DataType.ARRAY))
+
+
+class NGram(Transformer, HasInputCol, HasOutputCol, Wrappable):
+    n = Param("n", "N-gram length", TypeConverters.to_int)
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
+                 n: int = 2):
+        super().__init__()
+        if input_col:
+            self.set(self.input_col, input_col)
+        if output_col:
+            self.set(self.output_col, output_col)
+        self.set(self.n, n)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.ARRAY)]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        n = self.get(self.n)
+        out = np.empty(len(df), dtype=object)
+        for i, tokens in enumerate(df[self.get(self.input_col)]):
+            tokens = list(tokens)
+            out[i] = [
+                " ".join(tokens[j : j + n]) for j in range(len(tokens) - n + 1)
+            ]
+        return df.with_column(self.get(self.output_col), Column(out, DataType.ARRAY))
+
+
+class HashingTF(Transformer, HasInputCol, HasOutputCol, Wrappable):
+    """Token list -> dense term-frequency vector by stable hashing."""
+
+    num_features = Param("num_features", "Vector width (hash buckets)", TypeConverters.to_int)
+    binary = Param("binary", "1/0 presence instead of counts", TypeConverters.to_boolean)
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
+                 num_features: int = 4096, binary: bool = False):
+        super().__init__()
+        if input_col:
+            self.set(self.input_col, input_col)
+        if output_col:
+            self.set(self.output_col, output_col)
+        self.set(self.num_features, num_features)
+        self.set(self.binary, binary)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.VECTOR)]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        width = self.get(self.num_features)
+        binary = self.get(self.binary)
+        values = df[self.get(self.input_col)]
+        out = np.zeros((len(values), width), np.float32)
+        for i, tokens in enumerate(values):
+            for t in tokens:
+                j = _stable_hash(str(t), width)
+                if binary:
+                    out[i, j] = 1.0
+                else:
+                    out[i, j] += 1.0
+        return df.with_column(self.get(self.output_col), out, DataType.VECTOR)
+
+
+class IDF(Estimator, HasInputCol, HasOutputCol, Wrappable):
+    min_doc_freq = Param("min_doc_freq", "Zero out terms in fewer docs", TypeConverters.to_int)
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
+                 min_doc_freq: int = 0):
+        super().__init__()
+        if input_col:
+            self.set(self.input_col, input_col)
+        if output_col:
+            self.set(self.output_col, output_col)
+        self.set(self.min_doc_freq, min_doc_freq)
+
+    def fit(self, df: DataFrame) -> "IDFModel":
+        tf = df[self.get(self.input_col)]
+        n = len(tf)
+        doc_freq = (tf > 0).sum(axis=0)
+        idf = np.log((n + 1.0) / (doc_freq + 1.0))
+        idf[doc_freq < self.get(self.min_doc_freq)] = 0.0
+        model = IDFModel(idf.astype(np.float64))
+        model.set(model.input_col, self.get(self.input_col))
+        model.set(model.output_col, self.get(self.output_col))
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.VECTOR)]
+
+
+class IDFModel(Model, HasInputCol, HasOutputCol, Wrappable):
+    idf = ComplexParam("idf", "Inverse document frequency vector")
+
+    def __init__(self, idf: Optional[np.ndarray] = None):
+        super().__init__()
+        if idf is not None:
+            self.set(self.idf, np.asarray(idf))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        idf = self.get(self.idf)
+        tf = df[self.get(self.input_col)]
+        return df.with_column(
+            self.get(self.output_col), tf * idf[None, :], DataType.VECTOR
+        )
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.VECTOR)]
+
+
+class TextFeaturizer(Estimator, HasInputCol, HasOutputCol, Wrappable):
+    """Composed text pipeline: tokenize -> stopwords -> ngrams -> TF -> IDF
+    (reference: TextFeaturizer.scala:179, same toggle params)."""
+
+    use_tokenizer = Param("use_tokenizer", "Tokenize the input", TypeConverters.to_boolean)
+    tokenizer_pattern = Param("tokenizer_pattern", "Regex pattern", TypeConverters.to_string)
+    tokenizer_gaps = Param("tokenizer_gaps", "Pattern matches gaps", TypeConverters.to_boolean)
+    to_lowercase = Param("to_lowercase", "Lowercase first", TypeConverters.to_boolean)
+    min_token_length = Param("min_token_length", "Minimum token length", TypeConverters.to_int)
+    use_stop_words_remover = Param("use_stop_words_remover", "Remove stop words", TypeConverters.to_boolean)
+    case_sensitive_stop_words = Param("case_sensitive_stop_words", "Case sensitive stops", TypeConverters.to_boolean)
+    use_ngram = Param("use_ngram", "Add n-grams", TypeConverters.to_boolean)
+    n = Param("n", "N-gram length", TypeConverters.to_int)
+    binary = Param("binary", "Binary term frequency", TypeConverters.to_boolean)
+    num_features = Param("num_features", "Hash width", TypeConverters.to_int)
+    use_idf = Param("use_idf", "Scale by IDF", TypeConverters.to_boolean)
+    min_doc_freq = Param("min_doc_freq", "IDF min document frequency", TypeConverters.to_int)
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
+                 **kwargs: Any):
+        super().__init__()
+        self._set_defaults(
+            use_tokenizer=True, tokenizer_pattern=r"\s+", tokenizer_gaps=True,
+            to_lowercase=True, min_token_length=0, use_stop_words_remover=False,
+            case_sensitive_stop_words=False, use_ngram=False, n=2, binary=False,
+            num_features=4096, use_idf=True, min_doc_freq=1,
+        )
+        if input_col:
+            self.set(self.input_col, input_col)
+        if output_col:
+            self.set(self.output_col, output_col)
+        self.set_params(**kwargs)
+
+    def _stages(self, out_col: str) -> List[Transformer]:
+        from mmlspark_tpu.core.schema import find_unused_column_name
+
+        cur = self.get(self.input_col)
+        stages: List[Any] = []
+        if self.get(self.use_tokenizer):
+            nxt = "__tokens__"
+            stages.append(RegexTokenizer(
+                cur, nxt, self.get(self.tokenizer_pattern), self.get(self.tokenizer_gaps),
+                self.get(self.to_lowercase), self.get(self.min_token_length),
+            ))
+            cur = nxt
+        if self.get(self.use_stop_words_remover):
+            nxt = "__nostops__"
+            stages.append(StopWordsRemover(
+                cur, nxt, case_sensitive=self.get(self.case_sensitive_stop_words)
+            ))
+            cur = nxt
+        if self.get(self.use_ngram):
+            nxt = "__ngrams__"
+            stages.append(NGram(cur, nxt, self.get(self.n)))
+            cur = nxt
+        tf_out = "__tf__" if self.get(self.use_idf) else out_col
+        stages.append(HashingTF(cur, tf_out, self.get(self.num_features), self.get(self.binary)))
+        return stages, tf_out
+
+    def fit(self, df: DataFrame) -> "TextFeaturizerModel":
+        out_col = self.get(self.output_col)
+        stages, tf_out = self._stages(out_col)
+        cur = df
+        for st in stages:
+            cur = st.transform(cur)
+        fitted: List[Transformer] = list(stages)
+        if self.get(self.use_idf):
+            idf = IDF(tf_out, out_col, self.get(self.min_doc_freq)).fit(cur)
+            fitted.append(idf)
+        model = TextFeaturizerModel(fitted, out_col)
+        model.set(model.input_col, self.get(self.input_col))
+        model.set(model.output_col, out_col)
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.VECTOR)]
+
+
+class TextFeaturizerModel(Model, HasInputCol, HasOutputCol, Wrappable):
+    stages = ComplexParam("stages", "Fitted sub-stages")
+
+    def __init__(self, stages: Optional[List[Transformer]] = None,
+                 final_col: Optional[str] = None):
+        super().__init__()
+        if stages is not None:
+            self.set(self.stages, stages)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        out = df
+        for st in self.get(self.stages):
+            out = st.transform(out)
+        keep = [c for c in out.columns if not c.startswith("__") or c in df.columns]
+        return out.select(*keep)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.VECTOR)]

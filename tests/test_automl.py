@@ -1,0 +1,294 @@
+"""Tests: text featurization, Featurize, AutoML train/stats/select/tune."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import metrics as M
+from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.automl import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    DiscreteHyperParam,
+    DoubleRangeHyperParam,
+    FindBestModel,
+    GridSpace,
+    HyperparamBuilder,
+    RandomSpace,
+    TrainClassifier,
+    TrainRegressor,
+    TuneHyperparameters,
+)
+from mmlspark_tpu.automl.statistics import auc_score, roc_curve
+from mmlspark_tpu.featurize import FastVectorAssembler, Featurize
+from mmlspark_tpu.gbdt import LightGBMClassifier, LightGBMRegressor
+from mmlspark_tpu.ml import LogisticRegression
+from mmlspark_tpu.text import (
+    HashingTF,
+    IDF,
+    NGram,
+    RegexTokenizer,
+    StopWordsRemover,
+    TextFeaturizer,
+    Tokenizer,
+)
+
+
+def _mixed_df(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, n)
+    num = rng.normal(size=n) + y * 2.0
+    cat = np.where(rng.random(n) < 0.5, "red", "blue")
+    cat[y > 0] = np.where(rng.random((y > 0).sum()) < 0.8, "green", "red")
+    return DataFrame.from_dict(
+        {"num": num, "color": list(cat), "label": y.astype(np.float64)}
+    ), y
+
+
+class TestText:
+    def test_tokenizer_variants(self):
+        df = DataFrame.from_dict({"t": ["Hello World Foo"]})
+        assert Tokenizer("t", "w").transform(df)["w"][0] == ["hello", "world", "foo"]
+        rt = RegexTokenizer("t", "w", pattern=r"[A-Za-z]+", gaps=False)
+        assert rt.transform(df)["w"][0] == ["hello", "world", "foo"]
+
+    def test_stopwords_and_ngram(self):
+        df = DataFrame.from_dict({"w": [["the", "cat", "sat"]]}, types={"w": DataType.ARRAY})
+        assert StopWordsRemover("w", "o").transform(df)["o"][0] == ["cat", "sat"]
+        assert NGram("w", "o", 2).transform(df)["o"][0] == ["the cat", "cat sat"]
+
+    def test_hashing_tf_stable(self):
+        df = DataFrame.from_dict({"w": [["a", "b", "a"]]}, types={"w": DataType.ARRAY})
+        v1 = HashingTF("w", "v", num_features=64).transform(df)["v"]
+        v2 = HashingTF("w", "v", num_features=64).transform(df)["v"]
+        np.testing.assert_array_equal(v1, v2)
+        assert v1.sum() == 3  # counts
+        vb = HashingTF("w", "v", num_features=64, binary=True).transform(df)["v"]
+        assert vb.sum() == 2  # presence
+
+    def test_idf(self):
+        df = DataFrame.from_dict(
+            {"w": [["a"], ["a", "b"]]}, types={"w": DataType.ARRAY}
+        )
+        tf = HashingTF("w", "tf", num_features=32).transform(df)
+        model = IDF("tf", "tfidf").fit(tf)
+        out = model.transform(tf)
+        # term in every doc gets lower weight than rare term
+        assert out["tfidf"].max() > 0
+
+    def test_text_featurizer_end_to_end(self):
+        df = DataFrame.from_dict(
+            {"text": ["good movie great plot", "bad movie awful plot",
+                      "great film", "awful film"]}
+        )
+        model = TextFeaturizer(
+            "text", "features", use_stop_words_remover=True, num_features=256
+        ).fit(df)
+        out = model.transform(df)
+        assert out["features"].shape == (4, 256)
+        assert not np.allclose(out["features"][0], out["features"][1])
+
+
+class TestFeaturize:
+    def test_assembler_with_metadata(self):
+        df = DataFrame.from_dict({"a": [1.0, 2.0], "v": np.ones((2, 3))})
+        out = FastVectorAssembler(["a", "v"], "f").transform(df)
+        assert out["f"].shape == (2, 4)
+        assert out.metadata("f")["ml_attr"]["names"] == ["a", "v_0", "v_1", "v_2"]
+
+    def test_featurize_mixed_types(self):
+        df, y = _mixed_df()
+        model = Featurize(["num", "color"], output_col="features").fit(df)
+        out = model.transform(df)
+        names = out.metadata("features")["ml_attr"]["names"]
+        assert "num" in names
+        assert any(n.startswith("color=") for n in names)  # one-hot
+        # numeric NaN imputation
+        df2 = DataFrame.from_dict({"num": [1.0, np.nan], "color": ["red", "blue"]})
+        m2 = Featurize(["num"], output_col="f").fit(df2)
+        assert not np.isnan(m2.transform(df2)["f"]).any()
+
+    def test_featurize_timestamp(self):
+        import datetime
+
+        ts = np.array([np.datetime64(datetime.datetime(2020, 5, 17, 8, 30))],
+                      dtype="datetime64[us]")
+        df = DataFrame.from_dict({"t": ts})
+        model = Featurize(["t"], output_col="f").fit(df)
+        v = model.transform(df)["f"][0]
+        assert v[0] == 2020 and v[1] == 5 and v[2] == 17
+
+
+class TestStatistics:
+    def test_auc_and_roc(self):
+        y = np.array([0, 0, 1, 1])
+        s = np.array([0.1, 0.4, 0.35, 0.8])
+        assert abs(auc_score(y, s) - 0.75) < 1e-9
+        roc = roc_curve(y, s)
+        assert roc["true_positive_rate"][-1] == 1.0
+
+    def test_classification_stats(self):
+        df = DataFrame.from_dict(
+            {
+                "label": [0.0, 0.0, 1.0, 1.0],
+                "scored_labels": [0.0, 1.0, 1.0, 1.0],
+                "scored_probabilities": np.array(
+                    [[0.9, 0.1], [0.4, 0.6], [0.2, 0.8], [0.1, 0.9]]
+                ),
+            }
+        )
+        out = ComputeModelStatistics().transform(df)
+        row = out.collect()[0]
+        assert row["evaluation_type"] == "Classification"
+        assert abs(row[M.ACCURACY] - 0.75) < 1e-9
+        assert row[M.AUC] == 1.0
+
+    def test_regression_stats(self):
+        df = DataFrame.from_dict(
+            {"label": [1.0, 2.0, 3.0], "scored_labels": [1.1, 2.1, 2.9]}
+        )
+        out = ComputeModelStatistics(evaluation_metric="regression").transform(df)
+        row = out.collect()[0]
+        assert abs(row[M.RMSE] - np.sqrt(np.mean([0.01, 0.01, 0.01]))) < 1e-9
+        assert row[M.R2] > 0.9
+
+    def test_per_instance_stats(self):
+        df = DataFrame.from_dict(
+            {
+                "label": [0.0, 1.0],
+                "scored_probabilities": np.array([[0.8, 0.2], [0.3, 0.7]]),
+            }
+        )
+        out = ComputePerInstanceStatistics().transform(df)
+        np.testing.assert_allclose(
+            out["log_loss"], [-np.log(0.8), -np.log(0.7)], rtol=1e-6
+        )
+        df2 = DataFrame.from_dict({"label": [1.0, 2.0], "scores": [1.5, 2.5]})
+        out2 = ComputePerInstanceStatistics(evaluation_metric="regression").transform(df2)
+        np.testing.assert_allclose(out2["L2_loss"], [0.25, 0.25])
+
+
+class TestTrain:
+    def test_train_classifier_string_labels(self):
+        df, y = _mixed_df()
+        sy = np.where(y > 0, "yes", "no")
+        df = df.drop("label").with_column("label", list(sy))
+        model = TrainClassifier(
+            LightGBMClassifier(num_iterations=20), label_col="label"
+        ).fit(df)
+        out = model.transform(df)
+        assert M.SCORED_LABELS_COL in out.columns
+        assert set(out[M.SCORED_LABELS_COL]) <= {"yes", "no"}
+        acc = (np.asarray(out[M.SCORED_LABELS_COL]) == sy).mean()
+        assert acc > 0.85
+        # stats pipeline consumes the scored frame (needs numeric labels)
+        relabeled = out.drop("label").with_column(
+            "label", (sy == "yes").astype(np.float64)
+        ).drop(M.SCORED_LABELS_COL).with_column(
+            M.SCORED_LABELS_COL,
+            (np.asarray(out[M.SCORED_LABELS_COL]) == "yes").astype(np.float64),
+        )
+        stats = ComputeModelStatistics().transform(relabeled)
+        assert stats.collect()[0][M.ACCURACY] > 0.85
+
+    def test_train_classifier_with_logreg(self):
+        df, y = _mixed_df()
+        model = TrainClassifier(
+            LogisticRegression(max_iter=30), label_col="label"
+        ).fit(df)
+        out = model.transform(df)
+        pred = np.asarray([float(v) for v in out[M.SCORED_LABELS_COL]])
+        assert (pred == y).mean() > 0.8
+
+    def test_train_regressor(self):
+        rng = np.random.default_rng(0)
+        x1 = rng.normal(size=150)
+        x2 = np.where(rng.random(150) < 0.5, "a", "b")
+        label = 2 * x1 + (x2 == "a") * 3.0
+        df = DataFrame.from_dict({"x1": x1, "x2": list(x2), "label": label})
+        model = TrainRegressor(
+            LightGBMRegressor(num_iterations=50), label_col="label"
+        ).fit(df)
+        out = model.transform(df)
+        assert M.SCORES_COL in out.columns
+        resid = out[M.SCORES_COL] - label
+        assert np.mean(resid ** 2) < np.var(label) * 0.3
+
+    def test_trained_model_persistence(self, tmp_path):
+        df, y = _mixed_df(100)
+        model = TrainClassifier(
+            LightGBMClassifier(num_iterations=5), label_col="label"
+        ).fit(df)
+        path = str(tmp_path / "tc")
+        model.save(path)
+        from mmlspark_tpu.automl import TrainedClassifierModel
+
+        loaded = TrainedClassifierModel.load(path)
+        np.testing.assert_allclose(
+            np.asarray([float(v) for v in loaded.transform(df)[M.SCORED_LABELS_COL]]),
+            np.asarray([float(v) for v in model.transform(df)[M.SCORED_LABELS_COL]]),
+        )
+
+
+class TestSelection:
+    def test_find_best_model(self):
+        df, y = _mixed_df()
+        strong = TrainClassifier(LightGBMClassifier(num_iterations=30), label_col="label").fit(df)
+        weak = TrainClassifier(LightGBMClassifier(num_iterations=1, num_leaves=2), label_col="label").fit(df)
+        best = FindBestModel([weak, strong], evaluation_metric=M.ACCURACY).fit(df)
+        assert best.get_best_model() is strong
+        metrics_df = best.get_all_model_metrics()
+        assert len(metrics_df) == 2
+        assert best.get_roc_curve() is not None
+
+    def test_tune_hyperparameters_grid(self):
+        df, y = _mixed_df(150)
+        est = TrainClassifier(LightGBMClassifier(num_iterations=10), label_col="label")
+        inner = est.get(est.model)
+        builder = HyperparamBuilder().add_hyperparam(
+            inner, "num_leaves", DiscreteHyperParam([3, 15])
+        )
+        space = GridSpace(builder.build())
+        tuned = TuneHyperparameters(
+            [est], evaluation_metric=M.ACCURACY, param_space=space,
+            number_of_folds=2, parallelism=2,
+        ).fit(df)
+        assert tuned.get(tuned.best_metric) > 0.7
+        assert "num_leaves" in tuned.get(tuned.best_params)
+        out = tuned.transform(df)
+        assert M.SCORED_LABELS_COL in out.columns
+
+    def test_tune_random_space_over_estimator_params(self):
+        df, y = _mixed_df(150)
+        est = LightGBMClassifier(num_iterations=10)
+        builder = HyperparamBuilder().add_hyperparam(
+            est, "num_leaves", DiscreteHyperParam([3, 15])
+        ).add_hyperparam(est, "learning_rate", DoubleRangeHyperParam(0.05, 0.3))
+        space = RandomSpace(builder.build(), seed=1)
+        wrapped = TrainClassifier(est, label_col="label")
+        tuned = TuneHyperparameters(
+            [wrapped], evaluation_metric=M.ACCURACY, param_space=space,
+            number_of_folds=2, num_runs=2, parallelism=1,
+        ).fit(df)
+        assert tuned.get(tuned.best_metric) > 0.7
+        assert set(tuned.get(tuned.best_params)) <= {"num_leaves", "learning_rate"}
+
+
+class TestReviewRegressions:
+    def test_stats_on_string_labels(self):
+        df = DataFrame.from_dict(
+            {"label": ["cat", "dog", "dog"], "scored_labels": ["cat", "dog", "cat"]}
+        )
+        row = ComputeModelStatistics().transform(df).collect()[0]
+        assert abs(row[M.ACCURACY] - 2 / 3) < 1e-9
+
+    def test_find_best_with_label_free_model(self):
+        # models lacking a label_col param must not crash FindBestModel
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 2, 80)
+        x = rng.normal(size=(80, 4)) + y[:, None]
+        df = DataFrame.from_dict({"features": x, "label": y.astype(float)})
+        from mmlspark_tpu.ml import LogisticRegression
+
+        m = LogisticRegression(max_iter=10).fit(df)
+        best = FindBestModel([m], evaluation_metric=M.ACCURACY).fit(df)
+        assert best.get_best_model() is m

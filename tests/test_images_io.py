@@ -205,3 +205,21 @@ class TestLayoutBridge:
         out = UnrollImage("image", "vec").transform(df)
         x = extract_feature_matrix(out.column("vec"), (4, 5, 3), "vec")
         np.testing.assert_array_equal(x[0], img.astype(np.float64))
+
+
+def test_grayscale_resize_matches_color_path():
+    img = _img(6, 6, 3)
+    gray3 = ops.color_format(img, "gray")  # 2-D
+    out2d = ops.resize(gray3, 4, 4)
+    out3d = ops.resize(gray3[:, :, None], 4, 4)[:, :, 0]
+    np.testing.assert_array_equal(out2d, out3d)
+
+
+def test_text_preprocessor_uppercase_keys():
+    from mmlspark_tpu.stages import TextPreprocessor
+    from mmlspark_tpu.core.dataframe import DataFrame
+
+    df = DataFrame.from_dict({"t": ["I love the USA"]})
+    tp = TextPreprocessor(map={"USA": "United States"}, input_col="t", output_col="o")
+    # keys normalize with the text; replacement values keep their case
+    assert list(tp.transform(df)["o"]) == ["i love the United States"]

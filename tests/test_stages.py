@@ -1,0 +1,220 @@
+"""Tests: utility + data-prep stage zoo."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.stages import (
+    Cacher,
+    CheckpointData,
+    ClassBalancer,
+    CleanMissingData,
+    DataConversion,
+    DropColumns,
+    EnsembleByKey,
+    Explode,
+    IndexToValue,
+    Lambda,
+    MultiColumnAdapter,
+    PartitionConsolidator,
+    PartitionSample,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    SummarizeData,
+    TextPreprocessor,
+    Timer,
+    UDFTransformer,
+    ValueIndexer,
+)
+
+
+def _df():
+    return DataFrame.from_dict(
+        {
+            "a": [1.0, 2.0, 3.0, 4.0],
+            "b": ["x", "y", "x", "z"],
+            "c": [10, 20, 30, 40],
+        }
+    )
+
+
+def test_drop_select_rename():
+    df = _df()
+    assert DropColumns(["b"]).transform(df).columns == ["a", "c"]
+    assert SelectColumns(["c", "a"]).transform(df).columns == ["c", "a"]
+    out = RenameColumn("a", "alpha").transform(df)
+    assert "alpha" in out.columns and "a" not in out.columns
+    # schema dry-runs agree
+    assert [f.name for f in DropColumns(["b"]).transform_schema(df.schema)] == ["a", "c"]
+
+
+def test_repartition_and_consolidator():
+    df = _df().repartition(4)
+    assert Repartition(2).transform(df).num_partitions == 2
+    assert PartitionConsolidator().transform(df).num_partitions == 1
+
+
+def test_explode():
+    df = DataFrame.from_dict(
+        {"id": [1, 2], "words": [["a", "b"], ["c"]]},
+        types={"words": DataType.ARRAY},
+    )
+    out = Explode("words", "word").transform(df)
+    assert len(out) == 3
+    assert list(out["word"]) == ["a", "b", "c"]
+    assert list(out["id"]) == [1, 1, 2]
+
+
+def test_lambda_and_udf():
+    df = _df()
+    lam = Lambda(lambda d: d.filter(d["a"] > 2.0))
+    assert len(lam.transform(df)) == 2
+    udf = UDFTransformer("b", "b_up", udf=str.upper)
+    assert list(udf.transform(df)["b_up"]) == ["X", "Y", "X", "Z"]
+    vec = UDFTransformer("a", "a2", vector_udf=lambda v: v * 2)
+    np.testing.assert_array_equal(vec.transform(df)["a2"], df["a"] * 2)
+    multi = UDFTransformer(
+        output_col="ac", input_cols=["a", "c"], udf=lambda a, c: a + c
+    )
+    np.testing.assert_array_equal(multi.transform(df)["ac"], df["a"] + df["c"])
+
+
+def test_timer_wraps_stage(caplog):
+    df = _df()
+    model = Timer(ValueIndexer("b", "b_idx")).fit(df)
+    out = model.transform(df)
+    assert "b_idx" in out.columns
+
+
+def test_cacher_passthrough():
+    df = _df()
+    assert Cacher().transform(df) is df
+
+
+def test_class_balancer():
+    df = DataFrame.from_dict({"label": [0, 0, 0, 1]})
+    model = ClassBalancer("label", "weight").fit(df)
+    out = model.transform(df)
+    np.testing.assert_allclose(out["weight"], [1.0, 1.0, 1.0, 3.0])
+
+
+def test_text_preprocessor():
+    df = DataFrame.from_dict({"t": ["Hello World", "goodbye world"]})
+    tp = TextPreprocessor(
+        map={"hello": "hi", "world": "earth"}, input_col="t", output_col="o"
+    )
+    assert list(tp.transform(df)["o"]) == ["hi earth", "goodbye earth"]
+
+
+def test_clean_missing_data_modes():
+    df = DataFrame.from_dict({"x": [1.0, np.nan, 3.0], "y": [np.nan, 4.0, 6.0]})
+    model = CleanMissingData(["x", "y"], ["x", "y"], "Mean").fit(df)
+    out = model.transform(df)
+    np.testing.assert_allclose(out["x"], [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(out["y"], [5.0, 4.0, 6.0])
+    med = CleanMissingData(["x"], ["x2"], "Median").fit(df).transform(df)
+    assert med["x2"][1] == 2.0
+    cus = CleanMissingData(["x"], ["x3"], "Custom", custom_value=-1.0).fit(df).transform(df)
+    assert cus["x3"][1] == -1.0
+
+
+def test_value_indexer_roundtrip():
+    df = _df()
+    model = ValueIndexer("b", "b_idx").fit(df)
+    out = model.transform(df)
+    assert out.dtype("b_idx") == DataType.DOUBLE
+    assert len(set(out["b_idx"])) == 3
+    back = IndexToValue("b_idx", "b_back").transform(out)
+    assert list(back["b_back"]) == list(df["b"])
+    # unseen value raises
+    df2 = DataFrame.from_dict({"b": ["new"]})
+    with pytest.raises(ValueError):
+        model.transform(df2)
+
+
+def test_data_conversion():
+    df = _df()
+    out = DataConversion(["a"], "integer").transform(df)
+    assert out.dtype("a") == DataType.INT
+    out = DataConversion(["c"], "string").transform(df)
+    assert list(out["c"]) == ["10", "20", "30", "40"]
+    out = DataConversion(["b"], "toCategorical").transform(df)
+    assert "categorical" in out.metadata("b")
+    out2 = DataConversion(["b"], "clearCategorical").transform(out)
+    assert "categorical" not in out2.metadata("b")
+    df3 = DataFrame.from_dict({"d": ["2020-01-02 03:04:05"]})
+    out3 = DataConversion(["d"], "date").transform(df3)
+    assert out3.dtype("d") == DataType.TIMESTAMP
+
+
+def test_summarize_data():
+    df = DataFrame.from_dict({"x": [1.0, 2.0, 3.0, np.nan], "s": ["a", "a", "b", None]})
+    out = SummarizeData().transform(df)
+    rows = {r["Feature"]: r for r in out.collect()}
+    assert rows["x"]["Missing Value Count"] == 1.0
+    assert rows["x"]["Mean"] == 2.0
+    assert rows["x"]["Median"] == 2.0
+    assert rows["s"]["Unique Value Count"] == 3.0  # a, b, None
+    # flag gating
+    slim = SummarizeData(basic=False, sample=False, percentiles=False).transform(df)
+    assert "Mean" not in slim.columns
+
+
+def test_partition_sample_modes():
+    df = DataFrame.from_dict({"x": np.arange(100.0)})
+    assert len(PartitionSample("Head", count=7).transform(df)) == 7
+    samp = PartitionSample("RandomSample", percent=0.2, seed=1).transform(df)
+    assert 5 < len(samp) < 40
+    absolute = PartitionSample(
+        "RandomSample", rs_mode="Absolute", count=30, seed=1
+    ).transform(df)
+    assert 15 < len(absolute) < 45
+    parts = PartitionSample("AssignToPartition", num_parts=4).transform(df)
+    assert set(parts["Partition"]) <= {0, 1, 2, 3}
+
+
+def test_multi_column_adapter():
+    df = _df().with_column("b2", ["p", "q", "p", "p"])
+    adapter = MultiColumnAdapter(
+        ValueIndexer(), input_cols=["b", "b2"], output_cols=["bi", "b2i"]
+    )
+    model = adapter.fit(df)
+    out = model.transform(df)
+    assert "bi" in out.columns and "b2i" in out.columns
+
+
+def test_ensemble_by_key():
+    df = DataFrame.from_dict(
+        {
+            "k": ["a", "a", "b"],
+            "v": np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]),
+        }
+    )
+    out = EnsembleByKey(keys=["k"], cols=["v"], col_names=["vm"]).transform(df)
+    assert len(out) == 2
+    by_k = {r["k"]: r["vm"] for r in out.collect()}
+    np.testing.assert_allclose(by_k["a"], [2.0, 3.0])
+    # broadcast-back mode keeps all rows
+    out2 = EnsembleByKey(
+        keys=["k"], cols=["v"], col_names=["vm"], collapse_group=False
+    ).transform(df)
+    assert len(out2) == 3
+
+
+def test_checkpoint_data_disk_roundtrip():
+    df = _df()
+    out = CheckpointData(disk_included=True).transform(df)
+    assert out.columns == df.columns
+    np.testing.assert_array_equal(out["a"], df["a"])
+
+
+def test_stage_persistence_roundtrip(tmp_path):
+    df = _df()
+    model = ValueIndexer("b", "bi").fit(df)
+    path = str(tmp_path / "vi")
+    model.save(path)
+    from mmlspark_tpu.stages import ValueIndexerModel
+
+    loaded = ValueIndexerModel.load(path)
+    np.testing.assert_array_equal(loaded.transform(df)["bi"], model.transform(df)["bi"])
