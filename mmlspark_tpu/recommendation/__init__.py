@@ -1,0 +1,32 @@
+"""recommendation — SAR recommender + ranking evaluation.
+
+Equivalent of the reference's recommendation module (SURVEY.md §2.3, 2,407
+LoC): SAR.scala:64-188 (item-item similarity x time-decayed user affinity),
+SARModel.scala:141 (recommendForAllUsers), RecommendationIndexer,
+RankingAdapter, RankingEvaluator (NDCG/MAP@k), RankingTrainValidationSplit.
+
+TPU-first design: the reference computes co-occurrence and scores with Spark
+joins/aggregations; here interactions densify to a user x item matrix so
+co-occurrence (B^T B) and scoring (A @ S) are two MXU matmuls under jit.
+"""
+
+from mmlspark_tpu.recommendation.indexer import (
+    RecommendationIndexer,
+    RecommendationIndexerModel,
+)
+from mmlspark_tpu.recommendation.sar import SAR, SARModel
+from mmlspark_tpu.recommendation.ranking import (
+    RankingAdapter,
+    RankingEvaluator,
+    RankingTrainValidationSplit,
+)
+
+__all__ = [
+    "RankingAdapter",
+    "RankingEvaluator",
+    "RankingTrainValidationSplit",
+    "RecommendationIndexer",
+    "RecommendationIndexerModel",
+    "SAR",
+    "SARModel",
+]

@@ -1,0 +1,78 @@
+"""RecommendationIndexer: map raw user/item ids to dense indices.
+
+Reference: recommendation/RecommendationIndexer.scala — a two-column
+ValueIndexer whose model also exposes the inverse mapping for presenting
+recommendations in original id space.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType, Field
+from mmlspark_tpu.core.params import ComplexParam, Param, TypeConverters, Wrappable
+from mmlspark_tpu.core.pipeline import Estimator, Model
+
+
+class _RecColParams:
+    user_input_col = Param("user_input_col", "Raw user id column", TypeConverters.to_string)
+    user_output_col = Param("user_output_col", "Indexed user column", TypeConverters.to_string)
+    item_input_col = Param("item_input_col", "Raw item id column", TypeConverters.to_string)
+    item_output_col = Param("item_output_col", "Indexed item column", TypeConverters.to_string)
+
+
+class RecommendationIndexer(Estimator, _RecColParams, Wrappable):
+    def __init__(self, user_input_col: str = "user", user_output_col: str = "user_idx",
+                 item_input_col: str = "item", item_output_col: str = "item_idx"):
+        super().__init__()
+        self.set(self.user_input_col, user_input_col)
+        self.set(self.user_output_col, user_output_col)
+        self.set(self.item_input_col, item_input_col)
+        self.set(self.item_output_col, item_output_col)
+
+    def fit(self, df: DataFrame) -> "RecommendationIndexerModel":
+        users = sorted(set(df._hashable_col(self.get(self.user_input_col))))
+        items = sorted(set(df._hashable_col(self.get(self.item_input_col))))
+        model = RecommendationIndexerModel(users, items)
+        for p in ("user_input_col", "user_output_col", "item_input_col", "item_output_col"):
+            model.set(p, self.get(p))
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [
+            Field(self.get(self.user_output_col), DataType.DOUBLE),
+            Field(self.get(self.item_output_col), DataType.DOUBLE),
+        ]
+
+
+class RecommendationIndexerModel(Model, _RecColParams, Wrappable):
+    user_levels = ComplexParam("user_levels", "Ordered user ids")
+    item_levels = ComplexParam("item_levels", "Ordered item ids")
+
+    def __init__(self, user_levels: Optional[List[Any]] = None,
+                 item_levels: Optional[List[Any]] = None):
+        super().__init__()
+        if user_levels is not None:
+            self.set(self.user_levels, list(user_levels))
+        if item_levels is not None:
+            self.set(self.item_levels, list(item_levels))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        u_index = {v: float(i) for i, v in enumerate(self.get(self.user_levels))}
+        i_index = {v: float(i) for i, v in enumerate(self.get(self.item_levels))}
+        u = [u_index[v] for v in df._hashable_col(self.get(self.user_input_col))]
+        it = [i_index[v] for v in df._hashable_col(self.get(self.item_input_col))]
+        out = df.with_column(
+            self.get(self.user_output_col), np.asarray(u, np.float64), DataType.DOUBLE
+        )
+        return out.with_column(
+            self.get(self.item_output_col), np.asarray(it, np.float64), DataType.DOUBLE
+        )
+
+    def recover_user(self, idx: int) -> Any:
+        return self.get(self.user_levels)[int(idx)]
+
+    def recover_item(self, idx: int) -> Any:
+        return self.get(self.item_levels)[int(idx)]

@@ -1,0 +1,244 @@
+"""Ranking evaluation: RankingAdapter, RankingEvaluator,
+RankingTrainValidationSplit.
+
+Reference: recommendation/RankingAdapter.scala, RankingEvaluator.scala
+(ndcgAt, map, precisionAtk, recallAtK), RankingTrainValidationSplit.scala
+(per-user stratified split + param-map search).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType, Field
+from mmlspark_tpu.core.params import ComplexParam, Param, TypeConverters, Wrappable
+from mmlspark_tpu.core.pipeline import Estimator, Evaluator, Model
+
+
+def _ndcg_at_k(pred: List[Any], label: List[Any], k: int) -> float:
+    if not label:
+        return 0.0
+    rel = set(label)
+    dcg = sum(
+        1.0 / np.log2(i + 2) for i, p in enumerate(pred[:k]) if p in rel
+    )
+    idcg = sum(1.0 / np.log2(i + 2) for i in range(min(len(rel), k)))
+    return float(dcg / idcg) if idcg else 0.0
+
+
+def _map_at_k(pred: List[Any], label: List[Any], k: int) -> float:
+    if not label:
+        return 0.0
+    rel = set(label)
+    hits = 0
+    total = 0.0
+    for i, p in enumerate(pred[:k]):
+        if p in rel:
+            hits += 1
+            total += hits / (i + 1.0)
+    return float(total / min(len(rel), k))
+
+
+def _precision_at_k(pred: List[Any], label: List[Any], k: int) -> float:
+    if k == 0:
+        return 0.0
+    rel = set(label)
+    return float(sum(1 for p in pred[:k] if p in rel) / k)
+
+
+def _recall_at_k(pred: List[Any], label: List[Any], k: int) -> float:
+    if not label:
+        return 0.0
+    rel = set(label)
+    return float(sum(1 for p in pred[:k] if p in rel) / len(rel))
+
+
+_METRICS = {
+    "ndcgAt": _ndcg_at_k,
+    "map": _map_at_k,
+    "precisionAtk": _precision_at_k,
+    "recallAtK": _recall_at_k,
+}
+
+
+class RankingEvaluator(Evaluator, Wrappable):
+    """Evaluate a (prediction list, label list) per-user DataFrame."""
+
+    k = Param("k", "Cutoff for @k metrics", TypeConverters.to_int)
+    metric_name = Param("metric_name", f"One of {sorted(_METRICS)}", TypeConverters.to_string)
+    prediction_col = Param("prediction_col", "Recommended item list column", TypeConverters.to_string)
+    label_col = Param("label_col", "Relevant item list column", TypeConverters.to_string)
+
+    def __init__(self, metric_name: str = "ndcgAt", k: int = 10,
+                 prediction_col: str = "prediction", label_col: str = "label"):
+        super().__init__()
+        self._set_defaults(
+            metric_name="ndcgAt", k=10, prediction_col="prediction", label_col="label"
+        )
+        if metric_name not in _METRICS:
+            raise ValueError(f"metric_name must be one of {sorted(_METRICS)}")
+        self.set(self.metric_name, metric_name)
+        self.set(self.k, k)
+        self.set(self.prediction_col, prediction_col)
+        self.set(self.label_col, label_col)
+
+    def evaluate(self, df: DataFrame) -> float:
+        fn = _METRICS[self.get(self.metric_name)]
+        k = self.get(self.k)
+        preds = df[self.get(self.prediction_col)]
+        labels = df[self.get(self.label_col)]
+        values = [fn(list(p), list(l), k) for p, l in zip(preds, labels)]
+        return float(np.mean(values)) if values else 0.0
+
+    def is_larger_better(self) -> bool:
+        return True
+
+
+class RankingAdapter(Estimator, Wrappable):
+    """Fit a recommender, emit per-user (prediction, label) lists for the
+    evaluator (reference RankingAdapter mode='allUsers')."""
+
+    recommender = ComplexParam("recommender", "The recommendation estimator (SAR)")
+    k = Param("k", "Recommendations per user", TypeConverters.to_int)
+    min_ratings_per_user = Param(
+        "min_ratings_per_user", "Drop users with fewer relevant items", TypeConverters.to_int
+    )
+
+    def __init__(self, recommender=None, k: int = 10, min_ratings_per_user: int = 1):
+        super().__init__()
+        self._set_defaults(k=10, min_ratings_per_user=1)
+        if recommender is not None:
+            self.set(self.recommender, recommender)
+        self.set(self.k, k)
+        self.set(self.min_ratings_per_user, min_ratings_per_user)
+
+    def fit(self, df: DataFrame) -> "RankingAdapterModel":
+        rec = self.get(self.recommender)
+        fitted = rec.fit(df)
+        model = RankingAdapterModel(fitted, rec.get("user_col"), rec.get("item_col"))
+        model.set(model.k, self.get(self.k))
+        model.set(model.min_ratings_per_user, self.get(self.min_ratings_per_user))
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return [
+            Field("user", DataType.LONG),
+            Field("prediction", DataType.ARRAY),
+            Field("label", DataType.ARRAY),
+        ]
+
+
+class RankingAdapterModel(Model, Wrappable):
+    recommender_model = ComplexParam("recommender_model", "Fitted recommender")
+    user_col_name = Param("user_col_name", "User column", TypeConverters.to_string)
+    item_col_name = Param("item_col_name", "Item column", TypeConverters.to_string)
+    k = Param("k", "Recommendations per user", TypeConverters.to_int)
+    min_ratings_per_user = Param(
+        "min_ratings_per_user", "Drop users with fewer relevant items", TypeConverters.to_int
+    )
+
+    def __init__(self, recommender_model=None, user_col: str = "user_idx",
+                 item_col: str = "item_idx"):
+        super().__init__()
+        self._set_defaults(k=10, min_ratings_per_user=1)
+        if recommender_model is not None:
+            self.set(self.recommender_model, recommender_model)
+        self.set(self.user_col_name, user_col)
+        self.set(self.item_col_name, item_col)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        """df = held-out interactions; label = the user's actual items there,
+        prediction = the model's top-k (seen-in-training removed)."""
+        rec_model = self.get(self.recommender_model)
+        recs = rec_model.recommend_for_all_users(self.get(self.k))
+        rec_by_user: Dict[int, List[int]] = {
+            int(u): list(r)
+            for u, r in zip(recs[recs.columns[0]], recs["recommendations"])
+        }
+        u_col, i_col = self.get(self.user_col_name), self.get(self.item_col_name)
+        actual: Dict[int, List[int]] = {}
+        for u, i in zip(df[u_col].astype(np.int64), df[i_col].astype(np.int64)):
+            actual.setdefault(int(u), []).append(int(i))
+        min_r = self.get(self.min_ratings_per_user)
+        rows_u, rows_p, rows_l = [], [], []
+        for u, items in sorted(actual.items()):
+            if len(items) < min_r:
+                continue
+            rows_u.append(u)
+            rows_p.append(rec_by_user.get(u, []))
+            rows_l.append(items)
+        pred = np.empty(len(rows_p), object)
+        lab = np.empty(len(rows_l), object)
+        for i, (p, l) in enumerate(zip(rows_p, rows_l)):
+            pred[i], lab[i] = p, l
+        return DataFrame(
+            {
+                "user": Column(np.asarray(rows_u, np.int64), DataType.LONG),
+                "prediction": Column(pred, DataType.ARRAY),
+                "label": Column(lab, DataType.ARRAY),
+            }
+        )
+
+
+class RankingTrainValidationSplit(Estimator, Wrappable):
+    """Per-user stratified train/validation split + param search
+    (reference RankingTrainValidationSplit.scala)."""
+
+    estimator = ComplexParam("estimator", "Recommender estimator (SAR)")
+    evaluator = ComplexParam("evaluator", "RankingEvaluator")
+    param_maps = ComplexParam("param_maps", "List of {param_name: value} dicts")
+    train_ratio = Param("train_ratio", "Per-user train fraction", TypeConverters.to_float)
+    seed = Param("seed", "Split RNG seed", TypeConverters.to_int)
+    user_col = Param("user_col", "User column", TypeConverters.to_string)
+    item_col = Param("item_col", "Item column", TypeConverters.to_string)
+
+    def __init__(self, estimator=None, evaluator: Optional[RankingEvaluator] = None,
+                 param_maps: Optional[List[Dict[str, Any]]] = None,
+                 train_ratio: float = 0.75, seed: int = 0,
+                 user_col: str = "user_idx", item_col: str = "item_idx"):
+        super().__init__()
+        self._set_defaults(
+            train_ratio=0.75, seed=0, user_col="user_idx", item_col="item_idx"
+        )
+        if estimator is not None:
+            self.set(self.estimator, estimator)
+        self.set(self.evaluator, evaluator or RankingEvaluator())
+        self.set(self.param_maps, param_maps or [{}])
+        self.set(self.train_ratio, train_ratio)
+        self.set(self.seed, seed)
+        self.set(self.user_col, user_col)
+        self.set(self.item_col, item_col)
+
+    def _split(self, df: DataFrame) -> Tuple[DataFrame, DataFrame]:
+        rng = np.random.default_rng(self.get(self.seed))
+        users = df[self.get(self.user_col)].astype(np.int64)
+        ratio = self.get(self.train_ratio)
+        train_mask = np.zeros(len(df), bool)
+        for u in np.unique(users):
+            idx = np.nonzero(users == u)[0]
+            idx = idx[rng.permutation(len(idx))]
+            n_train = max(1, int(round(len(idx) * ratio)))
+            train_mask[idx[:n_train]] = True
+        return df.filter(train_mask), df.filter(~train_mask)
+
+    def fit(self, df: DataFrame) -> "Model":
+        train, valid = self._split(df)
+        evaluator: RankingEvaluator = self.get(self.evaluator)
+        best_model, best_value = None, None
+        for pmap in self.get(self.param_maps):
+            est = self.get(self.estimator).copy()
+            for name, value in pmap.items():
+                est.set(name, value)
+            adapter = RankingAdapter(est, k=evaluator.get(evaluator.k))
+            fitted = adapter.fit(train)
+            ranked = fitted.transform(valid)
+            value = evaluator.evaluate(ranked)
+            if best_value is None or value > best_value:
+                best_model, best_value = fitted, value
+        best_model._validation_metric = best_value
+        return best_model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema
