@@ -27,6 +27,7 @@ from mmlspark_tpu.gbdt.objectives import Objective, make_objective
 from mmlspark_tpu.gbdt.tree import Tree
 
 _MAX_CAT_VALUES = 256
+_CAT_WIDTH_CAP = 4096  # dense (T, m, C) bool mask: bound device memory
 
 
 class Booster:
@@ -84,7 +85,29 @@ class Booster:
         feats = np.zeros((t, m), np.int32)
         thr = np.full((t, m), np.inf, np.float32)
         is_cat = np.zeros((t, m), bool)
-        cat_mask = np.zeros((t, m, _MAX_CAT_VALUES), bool)
+        # Mask width covers the largest category value in ANY tree (loaded
+        # native models can exceed max_bin), plus one guaranteed-empty top
+        # slot: the tree walk clips values to width-1, so anything beyond
+        # the largest known category lands on an all-False slot and routes
+        # right instead of silently aliasing a real category. Width is
+        # capped (the mask is dense, (T, m, C) bool) — categories beyond
+        # the cap route right, with a loud warning instead of silence.
+        max_cat = -1
+        for tr in self.trees:
+            for node in range(tr.num_nodes):
+                if tr.is_categorical[node] and tr.cat_left[node]:
+                    max_cat = max(max_cat, max(tr.cat_left[node]))
+        cat_width = max(_MAX_CAT_VALUES, min(max_cat + 2, _CAT_WIDTH_CAP))
+        if max_cat + 2 > _CAT_WIDTH_CAP:
+            import warnings
+
+            warnings.warn(
+                f"categorical split values up to {max_cat} exceed the dense "
+                f"mask cap ({_CAT_WIDTH_CAP}); values >= {_CAT_WIDTH_CAP - 1} "
+                "will route to the right child",
+                RuntimeWarning,
+            )
+        cat_mask = np.zeros((t, m, cat_width), bool)
         lefts = np.zeros((t, m), np.int32)
         rights = np.zeros((t, m), np.int32)
         is_leaf = np.ones((t, m), bool)
@@ -103,7 +126,7 @@ class Booster:
                 is_leaf[i, node] = False
                 if tr.is_categorical[node]:
                     is_cat[i, node] = True
-                    vals = [v for v in tr.cat_left[node] if 0 <= v < _MAX_CAT_VALUES]
+                    vals = [v for v in tr.cat_left[node] if 0 <= v < cat_width - 1]
                     cat_mask[i, node, vals] = True
                 else:
                     thr[i, node] = tr.threshold_value[node]

@@ -214,6 +214,10 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
         init_model = None
         if self.is_set(self.model_string) and self.get(self.model_string):
             init_model = Booster.from_string(self.get(self.model_string))
+        init_raw = None
+        if self.is_set(self.init_score_col):
+            col = df.column(self.get(self.init_score_col))
+            init_raw = np.asarray(col.values, np.float64)
         feature_names = None
         meta = df.metadata(fcol)
         if meta.get("ml_attr", {}).get("names"):
@@ -223,6 +227,7 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
             self._train_config(self._categorical_indexes(df)),
             sample_weight=w, valid_mask=valid_mask,
             init_model=init_model, feature_names=feature_names,
+            init_raw=init_raw,
         )
 
 

@@ -73,6 +73,7 @@ def train_booster(
     valid_mask: Optional[np.ndarray] = None,
     init_model: Optional[Booster] = None,
     feature_names: Optional[List[str]] = None,
+    init_raw: Optional[np.ndarray] = None,
 ) -> Booster:
     import jax
     import jax.numpy as jnp
@@ -119,6 +120,10 @@ def train_booster(
                     [sample_weight, np.zeros(pad, np.float64)]
                 )
             train_rows = np.concatenate([train_rows, np.zeros(pad, bool)])
+            if init_raw is not None:
+                init_raw = np.concatenate(
+                    [init_raw, np.zeros((pad,) + init_raw.shape[1:], init_raw.dtype)]
+                )
             n += pad
 
         def shard(a):
@@ -142,8 +147,31 @@ def train_booster(
     init_score = objective.init_score(y[train_rows], None if sample_weight is None
                                       else sample_weight[train_rows])
     if init_model is not None:
-        raw = shard(init_model.predict_raw(x).astype(np.float32))
+        raw_np0 = init_model.predict_raw(x).astype(np.float32)
+        if init_raw is not None:
+            # dataset init_score composes with continued training: base
+            # margins add on top of the init model's scores (upstream
+            # LightGBM semantics)
+            extra = np.asarray(init_raw, np.float32)
+            if raw_np0.ndim == 2 and extra.ndim == 1:
+                extra = np.repeat(extra[:, None], raw_np0.shape[1], axis=1)
+            raw_np0 = raw_np0 + extra.reshape(raw_np0.shape)
+        raw = shard(raw_np0)
         init_score = init_model.init_score
+    elif init_raw is not None:
+        # Per-row base margin (LightGBM init_score field, DatasetSetField
+        # "init_score"): boosting starts from the user's scores, and the
+        # returned model carries init_score=0 — trees are deltas on top of
+        # the caller's margin, exactly the upstream contract.
+        arr = np.asarray(init_raw, np.float32)
+        if k > 1 and arr.ndim == 1:
+            arr = np.repeat(arr[:, None], k, axis=1)
+        if arr.shape[0] != n:
+            raise ValueError(
+                f"init_score rows {arr.shape[0]} != data rows {n}"
+            )
+        init_score = np.zeros(k, np.float64)
+        raw = shard(arr if k > 1 else arr.reshape(n))
     else:
         raw_np0 = np.zeros((n, k) if k > 1 else (n,), np.float32) + (
             init_score[None, :] if k > 1 else np.float32(init_score[0])
@@ -194,6 +222,20 @@ def train_booster(
         tree_contrib_cache[tree_idx] = out
         return out
 
+    def drop_contrib(dropped: List[int]):
+        """Summed contribution of dropped trees, shaped like `raw`.
+
+        Multiclass boosting grows one tree per class per iteration (tree
+        index t belongs to class t % k), so each dropped tree's (n,)
+        contribution lands only in its own class column of the (n, k) sum.
+        """
+        if k > 1:
+            out = jnp.zeros((n, k), jnp.float32)
+            for t in dropped:
+                out = out.at[:, t % k].add(tree_contrib(t))
+            return out
+        return sum(tree_contrib(t) for t in dropped)
+
     def walk_trees_binned_from_packed(packed, bins_dev, binner):
         # raw-value walk works from bins too if we feed bin uppers; simpler:
         # use the raw walker on the original x (host->device once per call)
@@ -226,16 +268,20 @@ def train_booster(
                 dropped = list(
                     rng.choice(len(trees), size=n_drop, replace=False)
                 )
-                drop_sum = sum(tree_contrib(t) for t in dropped)
-                raw_for_grad = raw - drop_sum
+                raw_for_grad = raw - drop_contrib(dropped)
 
         g_dev, h_dev = grad_fn(raw_for_grad)
 
         if goss_mode and it >= 1:
+            # Rank |gradient| over TRAIN rows only — padding (sharded runs)
+            # and validation rows must neither consume top_n/other_n slots
+            # nor inflate the fractions' denominator.
             g_abs = np.abs(np.asarray(g_dev if k == 1 else g_dev.sum(axis=1)))
-            top_n = int(cfg.top_rate * n)
-            other_n = int(cfg.other_rate * n)
-            order = np.argsort(-g_abs)
+            train_idx = np.flatnonzero(train_rows)
+            n_train = train_idx.size
+            top_n = int(cfg.top_rate * n_train)
+            other_n = int(cfg.other_rate * n_train)
+            order = train_idx[np.argsort(-g_abs[train_idx])]
             top_idx = order[:top_n]
             rest = order[top_n:]
             rest_idx = rng.choice(rest, size=min(other_n, len(rest)), replace=False)
@@ -283,7 +329,7 @@ def train_booster(
         if dart_mode and dropped:
             # scale dropped trees down and adjust raw by the delta
             scale = len(dropped) / (len(dropped) + 1.0)
-            delta = sum(tree_contrib(t) for t in dropped) * (scale - 1.0)
+            delta = drop_contrib(dropped) * (scale - 1.0)
             raw = raw + delta
             for t in dropped:
                 trees[t].leaf_value = [v * scale for v in trees[t].leaf_value]

@@ -28,17 +28,32 @@ def _ndcg_at_k(pred: List[Any], label: List[Any], k: int) -> float:
     return float(dcg / idcg) if idcg else 0.0
 
 
-def _map_at_k(pred: List[Any], label: List[Any], k: int) -> float:
-    if not label:
-        return 0.0
-    rel = set(label)
+def _ap_numerator(pred: List[Any], rel: set) -> float:
     hits = 0
     total = 0.0
-    for i, p in enumerate(pred[:k]):
+    for i, p in enumerate(pred):
         if p in rel:
             hits += 1
             total += hits / (i + 1.0)
-    return float(total / min(len(rel), k))
+    return total
+
+
+def _map_at_k(pred: List[Any], label: List[Any], k: int) -> float:
+    """Spark meanAveragePrecision semantics (reference RankingEvaluator
+    "map"): scan the FULL prediction list (no cutoff) and normalize by the
+    full relevant-set size."""
+    if not label:
+        return 0.0
+    rel = set(label)
+    return float(_ap_numerator(pred, rel) / len(rel))
+
+
+def _map_at_k_cut(pred: List[Any], label: List[Any], k: int) -> float:
+    """mapAtK variant: cut off at k, normalize by min(|relevant|, k)."""
+    if not label:
+        return 0.0
+    rel = set(label)
+    return float(_ap_numerator(pred[:k], rel) / min(len(rel), k))
 
 
 def _precision_at_k(pred: List[Any], label: List[Any], k: int) -> float:
@@ -58,6 +73,7 @@ def _recall_at_k(pred: List[Any], label: List[Any], k: int) -> float:
 _METRICS = {
     "ndcgAt": _ndcg_at_k,
     "map": _map_at_k,
+    "mapAtK": _map_at_k_cut,
     "precisionAtk": _precision_at_k,
     "recallAtK": _recall_at_k,
 }

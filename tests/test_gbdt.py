@@ -308,3 +308,72 @@ class TestDataParallel:
             single.get_booster().predict_raw(x),
             rtol=1e-4,
         )
+
+
+class TestAdviceFixes:
+    """Regression tests for the round-2 advisor findings (ADVICE.md)."""
+
+    def test_dart_multiclass(self):
+        # dart + k>1 used to crash with a broadcast error: drop sums were
+        # (n,) while raw scores are (n, K). skip_drop=0 forces dropping.
+        rng = np.random.default_rng(5)
+        y = rng.integers(0, 3, 240)
+        x = rng.normal(size=(240, 4))
+        x[:, 0] += y * 1.8
+        df = DataFrame.from_dict({"features": x, "label": y.astype(float)})
+        model = LightGBMClassifier(
+            num_iterations=15, boosting_type="dart", skip_drop=0.0,
+            drop_rate=0.3, num_leaves=7,
+        ).fit(df)
+        out = model.transform(df)
+        assert (out["prediction"] == y).mean() > 0.7
+
+    def test_goss_with_validation_rows(self):
+        # GOSS ranking must exclude validation rows from the top/other pools.
+        df, y = _binary_df(400, seed=3)
+        valid = np.zeros(400, bool)
+        valid[300:] = True
+        df = df.with_column("isVal", valid, DataType.BOOLEAN)
+        model = LightGBMClassifier(
+            num_iterations=20, boosting_type="goss",
+            validation_indicator_col="isVal", num_leaves=7,
+        ).fit(df)
+        p = model.transform(df)["probability"][:, 1]
+        assert _auc(y[:300], p[:300]) > 0.85
+
+    def test_init_score_col_seeds_boosting(self):
+        # Per-row base margins: boosting learns only the residual, and the
+        # returned model carries init_score=0 (trees are deltas).
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(300, 3))
+        y = 3.0 * x[:, 0] + rng.normal(size=300) * 0.01
+        df = DataFrame.from_dict({"features": x, "label": y})
+        df_init = df.with_column("base", y, DataType.DOUBLE)  # perfect init
+        reg = LightGBMRegressor(num_iterations=20, init_score_col="base")
+        model = reg.fit(df_init)
+        # with a perfect starting margin there is ~nothing left to learn
+        resid = model.transform(df)["prediction"]
+        assert np.abs(resid).mean() < 0.2 * np.abs(y).mean()
+        np.testing.assert_allclose(model.get_booster().init_score, 0.0)
+
+    def test_cat_mask_high_cardinality(self):
+        # Loaded native models may hold categorical values >= 256; they must
+        # route correctly, and out-of-vocabulary values must go right.
+        from mmlspark_tpu.gbdt.tree import Tree
+
+        tr = Tree()
+        tr.split_feature = [0]
+        tr.threshold_bin = [-1]
+        tr.threshold_value = [0.0]
+        tr.is_categorical = [True]
+        tr.cat_left = [[300, 5]]
+        tr.left_child = [~0]
+        tr.right_child = [~1]
+        tr.split_gain = [1.0]
+        tr.internal_value = [0.0]
+        tr.internal_count = [10]
+        tr.leaf_value = [1.0, -1.0]
+        tr.leaf_count = [5, 5]
+        b = Booster([tr], "regression", num_features=1)
+        pred = b.predict_raw(np.array([[300.0], [5.0], [100.0], [999.0]]))
+        np.testing.assert_allclose(pred, [1.0, 1.0, -1.0, -1.0])

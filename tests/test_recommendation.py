@@ -177,3 +177,19 @@ class TestRankingFlow:
         )
         best = tvs.fit(df)
         assert best._validation_metric >= 0.0
+
+
+class TestMapSemantics:
+    def test_map_normalizes_by_full_relevant_set(self):
+        from mmlspark_tpu.core.dataframe import DataFrame
+        from mmlspark_tpu.recommendation.ranking import _map_at_k, _map_at_k_cut
+
+        # 4 relevant items, k=2, both hits: Spark meanAveragePrecision
+        # divides by |relevant| = 4, the AtK variant by min(4, 2) = 2.
+        pred, label = [1, 2, 9, 9], [1, 2, 3, 4]
+        assert _map_at_k(pred, label, 2) == pytest.approx(0.5)
+        assert _map_at_k_cut(pred, label, 2) == pytest.approx(1.0)
+
+        df = DataFrame.from_dict({"prediction": [pred], "label": [label]})
+        assert RankingEvaluator("map", k=2).evaluate(df) == pytest.approx(0.5)
+        assert RankingEvaluator("mapAtK", k=2).evaluate(df) == pytest.approx(1.0)
