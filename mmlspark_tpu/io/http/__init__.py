@@ -1,0 +1,67 @@
+"""HTTP on Spark — client stages for calling web services from a DataFrame.
+
+Reference module: src/io/http (client half). Server half (Spark Serving)
+lives in mmlspark_tpu.serving.
+"""
+
+from mmlspark_tpu.io.http.clients import (
+    AsyncHTTPClient,
+    HTTPClientPool,
+    SingleThreadedHTTPClient,
+    advanced_handler,
+    basic_handler,
+    send_with_retries,
+)
+from mmlspark_tpu.io.http.parsers import (
+    CustomInputParser,
+    CustomOutputParser,
+    HTTPInputParser,
+    HTTPOutputParser,
+    JSONInputParser,
+    JSONOutputParser,
+    StringOutputParser,
+)
+from mmlspark_tpu.io.http.schema import (
+    EntityData,
+    HeaderData,
+    HTTPRequestData,
+    HTTPResponseData,
+    ProtocolVersionData,
+    RequestLineData,
+    StatusLineData,
+    entity_to_string,
+)
+from mmlspark_tpu.io.http.transformer import (
+    HasErrorCol,
+    HTTPParams,
+    HTTPTransformer,
+    SimpleHTTPTransformer,
+)
+
+__all__ = [
+    "AsyncHTTPClient",
+    "CustomInputParser",
+    "CustomOutputParser",
+    "EntityData",
+    "HasErrorCol",
+    "HeaderData",
+    "HTTPClientPool",
+    "HTTPInputParser",
+    "HTTPOutputParser",
+    "HTTPParams",
+    "HTTPRequestData",
+    "HTTPResponseData",
+    "HTTPTransformer",
+    "JSONInputParser",
+    "JSONOutputParser",
+    "ProtocolVersionData",
+    "RequestLineData",
+    "SimpleHTTPTransformer",
+    "SingleThreadedHTTPClient",
+    "StatusLineData",
+    "StringOutputParser",
+    "advanced_handler",
+    "basic_handler",
+    "entity_to_string",
+    "send_with_retries",
+]

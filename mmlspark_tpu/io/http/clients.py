@@ -1,0 +1,225 @@
+"""HTTP clients: retry/backoff handlers + single-threaded and async pools.
+
+Reference behavior being matched (not the JVM machinery):
+- `HandlingUtils.sendWithRetries` (HTTPClients.scala:55-134): 200/201/202/400
+  succeed immediately; 429 honors Retry-After then retries; other codes retry
+  after the next backoff delay; the LAST response is returned when retries
+  are exhausted (never an exception for an HTTP-level status).
+- `advanced(retryTimes*)` handler = sendWithRetries with a backoff-ms list;
+  `basic` = one shot, no retries (HTTPClients.scala:119-134).
+- `AsyncHTTPClient` (Clients.scala:102-116): up to `concurrency` requests in
+  flight per worker, responses yielded IN ORDER, each future bounded by
+  `concurrentTimeout`.
+
+Transport is http.client with per-(scheme,netloc) keep-alive connections in
+thread-local pools — the role of the Apache CloseableHttpClient pool, without
+the JVM. Connection-level failures retry on the same backoff schedule and
+raise after exhaustion (the reference's client.execute throw).
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Iterator, List, Optional, Tuple
+
+from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.io.http.schema import (
+    EntityData,
+    HeaderData,
+    HTTPRequestData,
+    HTTPResponseData,
+    ProtocolVersionData,
+    StatusLineData,
+)
+
+log = get_logger("mmlspark_tpu.io.http")
+
+# A handler turns (client, request) into a response — the HandlerFunc contract
+HandlerFunc = Callable[["HTTPClientPool", HTTPRequestData], HTTPResponseData]
+
+_SUCCESS_CODES = frozenset({200, 201, 202, 400})
+
+
+class HTTPClientPool:
+    """Thread-local keep-alive connections keyed by (scheme, netloc)."""
+
+    def __init__(self, request_timeout: float = 60.0):
+        self.request_timeout = request_timeout
+        self._local = threading.local()
+
+    def _connections(self) -> dict:
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            conns = {}
+            self._local.conns = conns
+        return conns
+
+    def _connect(self, scheme: str, netloc: str) -> http.client.HTTPConnection:
+        conns = self._connections()
+        conn = conns.get((scheme, netloc))
+        if conn is None:
+            cls = http.client.HTTPSConnection if scheme == "https" else http.client.HTTPConnection
+            conn = cls(netloc, timeout=self.request_timeout)
+            conns[(scheme, netloc)] = conn
+        return conn
+
+    def execute(self, request: HTTPRequestData) -> HTTPResponseData:
+        """One request over a pooled connection -> response data (any status)."""
+        url = urllib.parse.urlsplit(request.request_line.uri)
+        path = url.path or "/"
+        if url.query:
+            path += "?" + url.query
+        headers = {h.name: h.value for h in request.headers}
+        body = request.entity.content if request.entity else None
+        conn = self._connect(url.scheme or "http", url.netloc)
+        try:
+            conn.request(request.request_line.method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+        except (http.client.HTTPException, ConnectionError, OSError):
+            # stale keep-alive or dropped socket: rebuild the connection once
+            conn.close()
+            self._connections().pop((url.scheme or "http", url.netloc), None)
+            conn = self._connect(url.scheme or "http", url.netloc)
+            conn.request(request.request_line.method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+        content = resp.read()
+        entity = None
+        if content or resp.getheader("Content-Type"):
+            ct = resp.getheader("Content-Type")
+            entity = EntityData(
+                content=content,
+                content_length=len(content),
+                content_type=HeaderData("Content-Type", ct) if ct else None,
+            )
+        return HTTPResponseData(
+            headers=[HeaderData(k, v) for k, v in resp.getheaders()],
+            entity=entity,
+            status_line=StatusLineData(
+                ProtocolVersionData("HTTP", resp.version // 10, resp.version % 10),
+                resp.status,
+                resp.reason,
+            ),
+        )
+
+    def close(self) -> None:
+        for conn in self._connections().values():
+            conn.close()
+        self._local.conns = {}
+
+
+def send_with_retries(
+    client: HTTPClientPool,
+    request: HTTPRequestData,
+    retries_ms: Tuple[int, ...],
+) -> HTTPResponseData:
+    """sendWithRetries semantics (HTTPClients.scala:55-108)."""
+    last_exc: Optional[Exception] = None
+    response: Optional[HTTPResponseData] = None
+    for attempt in range(len(retries_ms) + 1):
+        try:
+            response = client.execute(request)
+            last_exc = None
+        except (http.client.HTTPException, ConnectionError, OSError) as e:
+            last_exc = e
+            response = None
+        if response is not None:
+            code = response.status_line.status_code
+            if code in _SUCCESS_CODES:
+                return response
+            if code == 429:
+                retry_after = next(
+                    (h.value for h in response.headers if h.name.lower() == "retry-after"),
+                    None,
+                )
+                if retry_after is not None:
+                    log.info("429: waiting %ss on %s", retry_after, request.request_line.uri)
+                    time.sleep(float(retry_after))
+                # 429 retries without consuming extra backoff beyond the schedule
+            else:
+                log.warning(
+                    "got error %d: %s on %s",
+                    code, response.status_line.reason_phrase, request.request_line.uri,
+                )
+        if attempt < len(retries_ms):
+            time.sleep(retries_ms[attempt] / 1000.0)
+    if response is None:
+        assert last_exc is not None
+        raise last_exc
+    return response
+
+
+def advanced_handler(*retries_ms: int) -> HandlerFunc:
+    """HandlingUtils.advanced(retryTimes*) — retrying handler factory."""
+
+    def handle(client: HTTPClientPool, request: HTTPRequestData) -> HTTPResponseData:
+        return send_with_retries(client, request, tuple(retries_ms))
+
+    handle.retries_ms = tuple(retries_ms)  # introspectable for persistence
+    return handle
+
+
+def basic_handler(client: HTTPClientPool, request: HTTPRequestData) -> HTTPResponseData:
+    """HandlingUtils.basic — one shot, no retries."""
+    return client.execute(request)
+
+
+class SingleThreadedHTTPClient:
+    """In-order, one-at-a-time sender (SingleThreadedClient mixin role)."""
+
+    def __init__(self, handler: HandlerFunc, request_timeout: float):
+        self.handler = handler
+        self.pool = HTTPClientPool(request_timeout)
+
+    def send(
+        self, requests: Iterable[Optional[HTTPRequestData]]
+    ) -> Iterator[Optional[HTTPResponseData]]:
+        for req in requests:
+            yield self.handler(self.pool, req) if req is not None else None
+
+    def close(self) -> None:
+        self.pool.close()
+
+
+class AsyncHTTPClient:
+    """Bounded-window concurrent sender preserving input order
+    (AsyncClient.sendRequestsWithContext, Clients.scala:102-116)."""
+
+    def __init__(
+        self,
+        handler: HandlerFunc,
+        concurrency: int,
+        concurrent_timeout: float,
+        request_timeout: float,
+    ):
+        self.handler = handler
+        self.concurrency = concurrency
+        self.concurrent_timeout = concurrent_timeout
+        self.pool = HTTPClientPool(request_timeout)
+        self._executor = ThreadPoolExecutor(max_workers=concurrency)
+
+    def send(
+        self, requests: Iterable[Optional[HTTPRequestData]]
+    ) -> Iterator[Optional[HTTPResponseData]]:
+        window: List = []
+        it = iter(requests)
+        try:
+            for req in it:
+                if req is None:
+                    window.append(None)
+                else:
+                    window.append(self._executor.submit(self.handler, self.pool, req))
+                if len(window) >= self.concurrency:
+                    head = window.pop(0)
+                    yield head.result(self.concurrent_timeout) if head is not None else None
+            for head in window:
+                yield head.result(self.concurrent_timeout) if head is not None else None
+        finally:
+            pass
+
+    def close(self) -> None:
+        self._executor.shutdown(wait=False)
+        self.pool.close()

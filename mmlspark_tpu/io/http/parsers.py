@@ -1,0 +1,218 @@
+"""Input/output parsers flanking HTTPTransformer in SimpleHTTPTransformer.
+
+Reference: src/io/http/src/main/scala/Parsers.scala — JSONInputParser
+(:31-83, row -> POST HTTPRequestData with JSON entity), CustomInputParser
+(:87-135, arbitrary row->request function), JSONOutputParser (:139-191,
+response entity -> parsed JSON), StringOutputParser (:195-210),
+CustomOutputParser (:214-270).
+
+JSON typing note: the reference parses into a user-supplied Spark DataType;
+this build parses into native Python objects (dict -> STRUCT column,
+list -> ARRAY) — schema-on-read, checked downstream, which is the idiomatic
+shape for a Python-native data plane.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, List, Optional
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType, Field
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    TypeConverters,
+    Wrappable,
+)
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.io.http.schema import (
+    HTTPRequestData,
+    HTTPResponseData,
+    entity_to_string,
+)
+
+
+class HTTPInputParser(Transformer, HasInputCol, HasOutputCol):
+    """Base: emits an HTTPRequestData column (Parsers.scala:21-26)."""
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.STRUCT)]
+
+
+class JSONInputParser(HTTPInputParser, Wrappable):
+    """Row value -> JSON POST request (Parsers.scala:31-83). Scalars wrap as
+    {input_col: value}; dicts/lists serialize as-is."""
+
+    url = Param("url", "Url of the service", TypeConverters.to_string)
+    method = Param("method", "HTTP method (PUT, POST, PATCH)", TypeConverters.to_string)
+    headers = Param("headers", "Extra request headers", TypeConverters.to_dict)
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
+                 url: Optional[str] = None, **kwargs: Any):
+        super().__init__()
+        self._set_defaults(headers={}, method="POST")
+        if input_col:
+            self.set_input_col(input_col)
+        if output_col:
+            self.set_output_col(output_col)
+        if url:
+            self.set(self.url, url)
+        self.set_params(**kwargs)
+
+    def set_url(self, v: str) -> "JSONInputParser":
+        return self.set(self.url, v)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        url = self.get(self.url)
+        method = self.get(self.method)
+        headers = self.get(self.headers)
+        in_name = self.get(self.input_col)
+        values = df.column(in_name).values
+        requests = []
+        for v in values:
+            if isinstance(v, (dict, list)):
+                body = json.dumps(v)
+            else:
+                body = json.dumps({in_name: _jsonable(v)})
+            requests.append(HTTPRequestData.post_json(url, body, headers, method))
+        import numpy as np
+
+        arr = np.empty(len(requests), object)
+        arr[:] = requests
+        return df.with_column(self.get(self.output_col), arr, DataType.STRUCT)
+
+
+def _jsonable(v: Any) -> Any:
+    import numpy as np
+
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v
+
+
+class CustomInputParser(HTTPInputParser, Wrappable):
+    """Arbitrary row -> HTTPRequestData function (Parsers.scala:87-135)."""
+
+    udf = ComplexParam("udf", "Function mapping an input value to HTTPRequestData")
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
+                 udf: Optional[Callable[[Any], HTTPRequestData]] = None):
+        super().__init__()
+        if input_col:
+            self.set_input_col(input_col)
+        if output_col:
+            self.set_output_col(output_col)
+        if udf is not None:
+            self.set(self.udf, udf)
+
+    def set_udf(self, f: Callable[[Any], HTTPRequestData]) -> "CustomInputParser":
+        return self.set(self.udf, f)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        import numpy as np
+
+        f = self.get(self.udf)
+        values = df.column(self.get(self.input_col)).values
+        out = np.empty(len(values), object)
+        out[:] = [f(v) for v in values]
+        return df.with_column(self.get(self.output_col), out, DataType.STRUCT)
+
+
+class HTTPOutputParser(Transformer, HasInputCol, HasOutputCol):
+    """Base: consumes an HTTPResponseData column (Parsers.scala:137-139)."""
+
+
+class JSONOutputParser(HTTPOutputParser, Wrappable):
+    """Response entity -> parsed JSON object per row (Parsers.scala:139-191).
+    Null/absent responses parse to None."""
+
+    post_processor = ComplexParam(
+        "post_processor", "Optional UDFTransformer applied to the parsed column"
+    )
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None):
+        super().__init__()
+        if input_col:
+            self.set_input_col(input_col)
+        if output_col:
+            self.set_output_col(output_col)
+
+    def set_post_process_func(self, f: Callable[[Any], Any]) -> "JSONOutputParser":
+        from mmlspark_tpu.stages.basic import UDFTransformer
+
+        return self.set(self.post_processor, UDFTransformer(udf=f))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        import numpy as np
+
+        values = df.column(self.get(self.input_col)).values
+        parsed = []
+        for r in values:
+            s = entity_to_string(r)
+            parsed.append(json.loads(s) if s else None)
+        out = np.empty(len(parsed), object)
+        out[:] = parsed
+        res = df.with_column(self.get(self.output_col), out, DataType.STRUCT)
+        pp = self.get_or_default(self.post_processor)
+        if pp is not None:
+            pp.set_input_col(self.get(self.output_col))
+            pp.set_output_col(self.get(self.output_col))
+            res = pp.transform(res)
+        return res
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.STRUCT)]
+
+
+class StringOutputParser(HTTPOutputParser, Wrappable):
+    """Response entity -> utf-8 string per row (Parsers.scala:195-210)."""
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None):
+        super().__init__()
+        if input_col:
+            self.set_input_col(input_col)
+        if output_col:
+            self.set_output_col(output_col)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        import numpy as np
+
+        values = df.column(self.get(self.input_col)).values
+        out = np.empty(len(values), object)
+        out[:] = [entity_to_string(r) for r in values]
+        return df.with_column(self.get(self.output_col), out, DataType.STRING)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.STRING)]
+
+
+class CustomOutputParser(HTTPOutputParser, Wrappable):
+    """Arbitrary HTTPResponseData -> value function (Parsers.scala:214-270)."""
+
+    udf = ComplexParam("udf", "Function mapping HTTPResponseData to an output value")
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
+                 udf: Optional[Callable[[Optional[HTTPResponseData]], Any]] = None):
+        super().__init__()
+        if input_col:
+            self.set_input_col(input_col)
+        if output_col:
+            self.set_output_col(output_col)
+        if udf is not None:
+            self.set(self.udf, udf)
+
+    def set_udf(self, f: Callable[[Optional[HTTPResponseData]], Any]) -> "CustomOutputParser":
+        return self.set(self.udf, f)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        import numpy as np
+
+        f = self.get(self.udf)
+        values = df.column(self.get(self.input_col)).values
+        out = np.empty(len(values), object)
+        out[:] = [f(r) for r in values]
+        return df.with_column(self.get(self.output_col), out, DataType.STRUCT)

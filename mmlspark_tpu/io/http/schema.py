@@ -1,0 +1,209 @@
+"""HTTP request/response data model — the wire schema of "HTTP on Spark".
+
+TPU-native redesign of the reference's case-class HTTP schemas
+(src/io/http/src/main/scala/HTTPSchema.scala:25-204: HeaderData, EntityData,
+StatusLineData, ProtocolVersionData, RequestLineData, HTTPRequestData,
+HTTPResponseData — all SparkBindings codecs). Here they are plain frozen-ish
+dataclasses carried as object rows in STRUCT columns; `to_dict`/`from_dict`
+give the Row-shaped view the reference encodes, so JSON round-trips and the
+serving wire format match.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class HeaderData:
+    name: str
+    value: str
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "value": self.value}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "HeaderData":
+        return HeaderData(d["name"], d["value"])
+
+
+@dataclasses.dataclass
+class EntityData:
+    """Message body. `content` is raw bytes (DataType.BINARY semantics)."""
+
+    content: bytes = b""
+    content_encoding: Optional[HeaderData] = None
+    content_length: Optional[int] = None
+    content_type: Optional[HeaderData] = None
+    is_chunked: bool = False
+    is_repeatable: bool = True
+    is_streaming: bool = False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "content": self.content,
+            "contentEncoding": self.content_encoding.to_dict() if self.content_encoding else None,
+            "contentLength": self.content_length,
+            "contentType": self.content_type.to_dict() if self.content_type else None,
+            "isChunked": self.is_chunked,
+            "isRepeatable": self.is_repeatable,
+            "isStreaming": self.is_streaming,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "EntityData":
+        return EntityData(
+            content=d.get("content", b""),
+            content_encoding=HeaderData.from_dict(d["contentEncoding"]) if d.get("contentEncoding") else None,
+            content_length=d.get("contentLength"),
+            content_type=HeaderData.from_dict(d["contentType"]) if d.get("contentType") else None,
+            is_chunked=bool(d.get("isChunked", False)),
+            is_repeatable=bool(d.get("isRepeatable", True)),
+            is_streaming=bool(d.get("isStreaming", False)),
+        )
+
+    @property
+    def string_content(self) -> str:
+        return self.content.decode("utf-8") if self.content else ""
+
+
+@dataclasses.dataclass
+class ProtocolVersionData:
+    protocol: str = "HTTP"
+    major: int = 1
+    minor: int = 1
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"protocol": self.protocol, "major": self.major, "minor": self.minor}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ProtocolVersionData":
+        return ProtocolVersionData(d.get("protocol", "HTTP"), d.get("major", 1), d.get("minor", 1))
+
+
+@dataclasses.dataclass
+class StatusLineData:
+    protocol_version: ProtocolVersionData
+    status_code: int
+    reason_phrase: str
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "protocolVersion": self.protocol_version.to_dict(),
+            "statusCode": self.status_code,
+            "reasonPhrase": self.reason_phrase,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "StatusLineData":
+        return StatusLineData(
+            ProtocolVersionData.from_dict(d.get("protocolVersion", {})),
+            d["statusCode"],
+            d.get("reasonPhrase", ""),
+        )
+
+
+@dataclasses.dataclass
+class RequestLineData:
+    method: str
+    uri: str
+    protocol_version: ProtocolVersionData = dataclasses.field(default_factory=ProtocolVersionData)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "method": self.method,
+            "uri": self.uri,
+            "protocolVersion": self.protocol_version.to_dict(),
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "RequestLineData":
+        return RequestLineData(
+            d["method"], d["uri"],
+            ProtocolVersionData.from_dict(d.get("protocolVersion", {})),
+        )
+
+
+@dataclasses.dataclass
+class HTTPRequestData:
+    request_line: RequestLineData
+    headers: List[HeaderData] = dataclasses.field(default_factory=list)
+    entity: Optional[EntityData] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "requestLine": self.request_line.to_dict(),
+            "headers": [h.to_dict() for h in self.headers],
+            "entity": self.entity.to_dict() if self.entity else None,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "HTTPRequestData":
+        return HTTPRequestData(
+            RequestLineData.from_dict(d["requestLine"]),
+            [HeaderData.from_dict(h) for h in d.get("headers", [])],
+            EntityData.from_dict(d["entity"]) if d.get("entity") else None,
+        )
+
+    @staticmethod
+    def post_json(url: str, body: str, headers: Optional[Dict[str, str]] = None,
+                  method: str = "POST") -> "HTTPRequestData":
+        """The JSONInputParser product: method+url+JSON entity
+        (reference: Parsers.scala JSONInputParser.transform)."""
+        hs = [HeaderData(k, v) for k, v in (headers or {}).items()]
+        hs.append(HeaderData("Content-type", "application/json"))
+        data = body.encode("utf-8")
+        return HTTPRequestData(
+            RequestLineData(method, url),
+            hs,
+            EntityData(
+                content=data,
+                content_length=len(data),
+                content_type=HeaderData("Content-type", "application/json"),
+            ),
+        )
+
+
+@dataclasses.dataclass
+class HTTPResponseData:
+    headers: List[HeaderData]
+    entity: Optional[EntityData]
+    status_line: StatusLineData
+    locale: str = "en"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "headers": [h.to_dict() for h in self.headers],
+            "entity": self.entity.to_dict() if self.entity else None,
+            "statusLine": self.status_line.to_dict(),
+            "locale": self.locale,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "HTTPResponseData":
+        return HTTPResponseData(
+            [HeaderData.from_dict(h) for h in d.get("headers", [])],
+            EntityData.from_dict(d["entity"]) if d.get("entity") else None,
+            StatusLineData.from_dict(d["statusLine"]),
+            d.get("locale", "en"),
+        )
+
+    @staticmethod
+    def ok(content: bytes, content_type: str = "application/json") -> "HTTPResponseData":
+        return HTTPResponseData(
+            headers=[],
+            entity=EntityData(
+                content=content,
+                content_length=len(content),
+                content_type=HeaderData("Content-type", content_type),
+            ),
+            status_line=StatusLineData(ProtocolVersionData(), 200, "OK"),
+        )
+
+
+def entity_to_string(response: Optional[HTTPResponseData]) -> Optional[str]:
+    """HTTPSchema.entity_to_string equivalent (HTTPSchema.scala)."""
+    if response is None or response.entity is None:
+        return None
+    return response.entity.string_content

@@ -1,0 +1,241 @@
+"""HTTPTransformer and SimpleHTTPTransformer — "HTTP on Spark" client stages.
+
+Reference: src/io/http/src/main/scala/HTTPTransformer.scala:78-128 (request
+column -> pooled/async calls -> response column) and
+SimpleHTTPTransformer.scala (mini-batch -> input parser -> HTTPTransformer ->
+error split -> output parser -> drop -> flatten, assembled as an internal
+PipelineModel).
+
+TPU-framework notes: a partition maps to a worker's row range; the client
+pool is a per-stage singleton (the reference's SharedVariable per-JVM
+clientHolder), so concurrent transforms reuse keep-alive connections.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType, Field
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    Params,
+    TypeConverters,
+    Wrappable,
+)
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.schema import find_unused_column_name
+from mmlspark_tpu.io.http.clients import (
+    AsyncHTTPClient,
+    SingleThreadedHTTPClient,
+    advanced_handler,
+)
+from mmlspark_tpu.io.http.parsers import (
+    HTTPInputParser,
+    HTTPOutputParser,
+    JSONInputParser,
+    JSONOutputParser,
+)
+from mmlspark_tpu.io.http.schema import HTTPResponseData, entity_to_string
+
+
+class HTTPParams(Params):
+    """Shared client knobs (HTTPTransformer.scala HTTPParams trait)."""
+
+    concurrency = Param(
+        "concurrency", "Max number of concurrent calls", TypeConverters.to_int
+    )
+    timeout = Param(
+        "timeout", "Seconds to wait before closing the connection", TypeConverters.to_float
+    )
+    concurrent_timeout = Param(
+        "concurrent_timeout",
+        "Max seconds to wait on a future if concurrency > 1",
+        TypeConverters.to_float,
+    )
+    retry_times = Param(
+        "retry_times",
+        "Backoff schedule in ms between retries (sendWithRetries)",
+        TypeConverters.to_list_int,
+    )
+    handler = ComplexParam(
+        "handler", "Override handler fn(client_pool, request) -> response"
+    )
+
+    def _http_defaults(self, retry_times: List[int]) -> None:
+        self._set_defaults(
+            concurrency=1, timeout=60.0, concurrent_timeout=100.0,
+            retry_times=retry_times,
+        )
+
+    def _make_handler(self):
+        if self.is_set(self.handler):
+            return self.get(self.handler)
+        return advanced_handler(*self.get(self.retry_times))
+
+    def _make_client(self):
+        if self.get(self.concurrency) <= 1:
+            return SingleThreadedHTTPClient(self._make_handler(), self.get(self.timeout))
+        return AsyncHTTPClient(
+            self._make_handler(),
+            self.get(self.concurrency),
+            self.get(self.concurrent_timeout),
+            self.get(self.timeout),
+        )
+
+
+class HasErrorCol(Params):
+    error_col = Param("error_col", "Column to hold http errors", TypeConverters.to_string)
+
+    def set_error_col(self, v: str):
+        return self.set(self.error_col, v)
+
+
+class HTTPTransformer(Transformer, HTTPParams, HasInputCol, HasOutputCol, Wrappable):
+    """HTTPRequestData column -> HTTPResponseData column
+    (HTTPTransformer.scala:78-128). None requests map to None responses."""
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
+                 **kwargs: Any):
+        super().__init__()
+        self._http_defaults([100, 500, 1000])
+        if input_col:
+            self.set_input_col(input_col)
+        if output_col:
+            self.set_output_col(output_col)
+        self.set_params(**kwargs)
+        self._client = None  # SharedVariable clientHolder role
+
+    def _get_client(self):
+        if self._client is None:
+            self._client = self._make_client()
+        return self._client
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        requests = df.column(self.get(self.input_col)).values
+        client = self._get_client()
+        responses = list(client.send(iter(requests)))
+        out = np.empty(len(responses), object)
+        out[:] = responses
+        return df.with_column(self.get(self.output_col), out, DataType.STRUCT)
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.STRUCT)]
+
+
+def _add_error(resp: Optional[HTTPResponseData]) -> Optional[dict]:
+    """ErrorUtils.addError (SimpleHTTPTransformer.scala:32-42): non-200
+    responses become {response, status} error rows; 200/None pass clean."""
+    if resp is None:
+        return None
+    if resp.status_line.status_code == 200:
+        return None
+    return {
+        "response": entity_to_string(resp),
+        "status": resp.status_line.to_dict(),
+    }
+
+
+class SimpleHTTPTransformer(Transformer, HTTPParams, HasInputCol, HasOutputCol,
+                            HasErrorCol, Wrappable):
+    """JSON-in -> call -> JSON-out sugar (SimpleHTTPTransformer.scala):
+    composes [mini_batcher?] -> input_parser -> HTTPTransformer -> error
+    split -> output_parser -> drop temp cols -> [flatten?]."""
+
+    input_parser = ComplexParam("input_parser", "HTTPInputParser for the input column")
+    output_parser = ComplexParam("output_parser", "HTTPOutputParser for the output column")
+    mini_batcher = ComplexParam("mini_batcher", "Optional MiniBatchTransformer")
+    flatten_output_batches = Param(
+        "flatten_output_batches", "Whether to flatten output batches",
+        TypeConverters.to_boolean,
+    )
+
+    def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
+                 url: Optional[str] = None, **kwargs: Any):
+        super().__init__()
+        self._http_defaults([0, 50, 100, 500])
+        self._set_defaults(error_col=None)
+        if input_col:
+            self.set_input_col(input_col)
+        if output_col:
+            self.set_output_col(output_col)
+        if url:
+            self.set_url(url)
+        self.set_params(**kwargs)
+
+    def set_url(self, url: str) -> "SimpleHTTPTransformer":
+        parser = self.get_or_default(self.input_parser)
+        if parser is None:
+            parser = JSONInputParser()
+        if not isinstance(parser, JSONInputParser):
+            raise ValueError("set_url is only available with a JSONInputParser")
+        return self.set(self.input_parser, parser.set_url(url))
+
+    def _error_col(self) -> str:
+        return self.get_or_default(self.error_col) or "errors"
+
+    def _pipeline_stages(self, df_columns: List[str]):
+        avoid = set(df_columns) | {self.get(self.output_col)}
+        parsed_col = find_unused_column_name("parsedInput", avoid)
+        unparsed_col = find_unused_column_name("unparsedOutput", avoid)
+
+        input_parser = self.get_or_default(self.input_parser) or JSONInputParser()
+        if not isinstance(input_parser, HTTPInputParser):
+            raise TypeError("input_parser must be an HTTPInputParser")
+        input_parser.set_input_col(self.get(self.input_col))
+        input_parser.set_output_col(parsed_col)
+
+        client = HTTPTransformer(input_col=parsed_col, output_col=unparsed_col)
+        client.set(client.retry_times, self.get(self.retry_times))
+        client.set(client.concurrency, self.get(self.concurrency))
+        client.set(client.concurrent_timeout, self.get(self.concurrent_timeout))
+        client.set(client.timeout, self.get(self.timeout))
+        if self.is_set(self.handler):
+            client.set(client.handler, self.get(self.handler))
+
+        output_parser = self.get_or_default(self.output_parser) or JSONOutputParser()
+        if not isinstance(output_parser, HTTPOutputParser):
+            raise TypeError("output_parser must be an HTTPOutputParser")
+        output_parser.set_input_col(unparsed_col)
+        output_parser.set_output_col(self.get(self.output_col))
+
+        return parsed_col, unparsed_col, input_parser, client, output_parser
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        mb = self.get_or_default(self.mini_batcher)
+        if mb is not None:
+            df = mb.transform(df)
+        (parsed_col, unparsed_col, input_parser, client,
+         output_parser) = self._pipeline_stages(df.columns)
+
+        cur = input_parser.transform(df)
+        cur = client.transform(cur)
+        # error split (ErrorUtils): non-200 -> error col, response nullified
+        responses = cur.column(unparsed_col).values
+        errors = np.empty(len(responses), object)
+        errors[:] = [_add_error(r) for r in responses]
+        cleaned = np.empty(len(responses), object)
+        cleaned[:] = [
+            r if (e is None and r is not None) else None
+            for r, e in zip(responses, errors)
+        ]
+        cur = cur.with_column(self._error_col(), errors, DataType.STRUCT)
+        cur = cur.with_column(unparsed_col, cleaned, DataType.STRUCT)
+        cur = output_parser.transform(cur)
+        cur = cur.drop(parsed_col, unparsed_col)
+        if mb is not None and self.get_or_default(self.flatten_output_batches, True) is not False:
+            from mmlspark_tpu.stages.batching import FlattenBatch
+
+            cur = FlattenBatch().transform(cur)
+        return cur
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [
+            Field(self._error_col(), DataType.STRUCT),
+            Field(self.get(self.output_col), DataType.STRUCT),
+        ]

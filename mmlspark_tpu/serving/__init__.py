@@ -1,0 +1,26 @@
+"""Spark Serving, TPU-native: turn a fitted pipeline into a web service.
+
+Reference: src/io/http Spark Serving — streaming sources/sinks that ARE web
+servers (HTTPSource.scala:46,184; DistributedHTTPSource.scala:89-242;
+continuous "1 ms" path HTTPSourceV2.scala:63-404) plus the
+parseRequest/makeReply sugar (ServingImplicits.scala:90-109).
+
+TPU-first redesign: the reference needs a streaming engine to shuttle
+request batches from per-executor JVM web servers through the pipeline and
+a sink to route replies back by (requestId, partitionId). In this runtime
+one process owns the chip, so the whole apparatus collapses into a resident
+server: requests enqueue into an exchange registry, an engine thread runs
+the fitted (jit-compiled, device-resident) pipeline over micro-batches, and
+replies complete the held exchanges. Continuous mode short-circuits the
+queue — the handler thread scores synchronously against the resident model
+for minimum latency. No offsets, no epochs, no port forwarding.
+"""
+
+from mmlspark_tpu.serving.server import (
+    ServingServer,
+    make_reply,
+    parse_request,
+    serve_pipeline,
+)
+
+__all__ = ["ServingServer", "make_reply", "parse_request", "serve_pipeline"]

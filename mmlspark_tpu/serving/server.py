@@ -1,0 +1,379 @@
+"""The serving engine: HTTP front end + micro-batch/continuous scorer.
+
+Semantics matched to the reference (see package docstring):
+- input DataFrame schema is [id: {requestId, partitionId}, request:
+  HTTPRequestData] (HTTPSourceV2.scala ID_SCHEMA/SCHEMA at :88-99)
+- the sink routes each reply row's `reply` HTTPResponseData back to the
+  exchange with that requestId (HTTPWriter, HTTPSourceV2.scala:421-476)
+- unanswered requests get 504s on shutdown; unknown routes get 404
+- `parse_request` / `make_reply` mirror ServingImplicits.scala:90-109
+
+Continuous mode is the reference's "1 ms latency" HTTPSourceProviderV2
+path: no batch wait at all — the handler thread calls the pipeline
+directly (batch of 1) under a model lock.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import socket
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.io.http.schema import (
+    EntityData,
+    HeaderData,
+    HTTPRequestData,
+    HTTPResponseData,
+    ProtocolVersionData,
+    RequestLineData,
+    StatusLineData,
+)
+
+log = get_logger("mmlspark_tpu.serving")
+
+
+# -- parseRequest / makeReply sugar (ServingImplicits.scala:90-109) -----------
+
+
+def parse_request(
+    df: DataFrame,
+    schema: Any = None,
+    id_col: str = "id",
+    request_col: str = "request",
+) -> DataFrame:
+    """Explode the JSON request entity into columns.
+
+    schema=None: every key across the batch becomes a column (object dtype).
+    schema=bytes: passthrough of the raw entity as a `bytes` column.
+    schema={"col": DataType, ...}: select + cast those keys.
+    """
+    requests: List[Optional[HTTPRequestData]] = list(df.column(request_col).values)
+    ids = df.column(id_col).values
+    if schema is bytes:
+        content = np.empty(len(requests), object)
+        content[:] = [r.entity.content if r and r.entity else None for r in requests]
+        return DataFrame.from_dict({id_col: ids}).with_column(
+            "bytes", content, DataType.BINARY
+        )
+    parsed: List[dict] = []
+    for r in requests:
+        body = r.entity.string_content if r and r.entity else ""
+        try:
+            obj = json.loads(body) if body else {}
+        except json.JSONDecodeError:
+            obj = {}
+        parsed.append(obj if isinstance(obj, dict) else {"value": obj})
+    if schema is None:
+        keys: List[str] = []
+        for p in parsed:
+            for k in p:
+                if k not in keys:
+                    keys.append(k)
+        typed = {k: None for k in keys}
+    else:
+        typed = dict(schema)
+    out = DataFrame.from_dict({id_col: np.asarray(ids, object)})
+    for k, dtype in typed.items():
+        vals = [p.get(k) for p in parsed]
+        if dtype is not None and isinstance(dtype, DataType) and dtype.is_numeric:
+            arr: Any = np.asarray(
+                [np.nan if v is None else v for v in vals], np.float64
+            )
+            out = out.with_column(k, arr, DataType.DOUBLE)
+        elif dtype == DataType.VECTOR:
+            arr = np.asarray(vals, np.float64)
+            out = out.with_column(k, arr, DataType.VECTOR)
+        else:
+            arr = np.empty(len(vals), object)
+            arr[:] = vals
+            out = out.with_column(k, arr)
+    return out
+
+
+def make_reply(df: DataFrame, reply_col: str, name: str = "reply") -> DataFrame:
+    """Wrap a column as HTTPResponseData (ServingImplicits.makeReply):
+    str -> text entity; bytes -> binary; anything else -> JSON."""
+    values = df.column(reply_col).values
+    replies = np.empty(len(values), object)
+    out: List[HTTPResponseData] = []
+    for v in values:
+        if isinstance(v, str):
+            out.append(HTTPResponseData.ok(v.encode("utf-8"), "text/plain"))
+        elif isinstance(v, (bytes, bytearray)):
+            out.append(HTTPResponseData.ok(bytes(v), "application/octet-stream"))
+        else:
+            out.append(
+                HTTPResponseData.ok(json.dumps(_to_jsonable(v)).encode("utf-8"))
+            )
+    replies[:] = out
+    return df.with_column(name, replies, DataType.STRUCT)
+
+
+def _to_jsonable(v: Any) -> Any:
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, dict):
+        return {k: _to_jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_to_jsonable(x) for x in v]
+    return v
+
+
+# -- the server ---------------------------------------------------------------
+
+
+class _Exchange:
+    """One held HTTP exchange awaiting its reply (the reference keeps the
+    com.sun HttpExchange open in MultiChannelMap / the partition reader)."""
+
+    __slots__ = ("request", "event", "response")
+
+    def __init__(self, request: HTTPRequestData):
+        self.request = request
+        self.event = threading.Event()
+        self.response: Optional[HTTPResponseData] = None
+
+    def respond(self, response: HTTPResponseData) -> None:
+        self.response = response
+        self.event.set()
+
+
+class ServingServer:
+    """Serve `handler(df) -> df` over HTTP.
+
+    handler receives the [id, request] DataFrame and must return a frame
+    containing `id` and a reply column of HTTPResponseData (usually built
+    with parse_request/make_reply around a fitted PipelineModel).
+
+    mode="continuous": score per-request in the handler thread (lowest
+    latency — the reference's HTTPSourceProviderV2 path).
+    mode="micro_batch": queue up to max_batch_size requests (waiting at most
+    max_wait_ms) and score them in one pipeline call (DistributedHTTPSource
+    batch path) — higher throughput per chip, a little more latency.
+    """
+
+    def __init__(
+        self,
+        handler: Callable[[DataFrame], DataFrame],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        api_name: str = "serving",
+        mode: str = "continuous",
+        max_batch_size: int = 64,
+        max_wait_ms: float = 5.0,
+        reply_col: str = "reply",
+        request_timeout: float = 30.0,
+    ):
+        if mode not in ("continuous", "micro_batch"):
+            raise ValueError("mode must be 'continuous' or 'micro_batch'")
+        self.handler = handler
+        self.host = host
+        self.api_name = api_name
+        self.mode = mode
+        self.max_batch_size = max_batch_size
+        self.max_wait_ms = max_wait_ms
+        self.reply_col = reply_col
+        self.request_timeout = request_timeout
+        self._queue: List[tuple] = []
+        self._queue_lock = threading.Condition()
+        self._model_lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._engine_thread: Optional[threading.Thread] = None
+        self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+        self._port = port
+
+    # - wiring ---------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self._port}/{self.api_name}"
+
+    def start(self) -> "ServingServer":
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            # small header+body writes otherwise hit Nagle + delayed-ACK
+            # (~40 ms per exchange) — fatal for the 1 ms latency target
+            disable_nagle_algorithm = True
+
+            def log_message(self, fmt, *args):  # route into our logger
+                log.debug("%s " + fmt, self.address_string(), *args)
+
+            def _read_request(self) -> HTTPRequestData:
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                ct = self.headers.get("Content-Type")
+                return HTTPRequestData(
+                    RequestLineData(self.command, self.path),
+                    [HeaderData(k, v) for k, v in self.headers.items()],
+                    EntityData(
+                        content=body,
+                        content_length=len(body),
+                        content_type=HeaderData("Content-Type", ct) if ct else None,
+                    ),
+                )
+
+            def _send(self, resp: HTTPResponseData) -> None:
+                body = resp.entity.content if resp.entity else b""
+                self.send_response(
+                    resp.status_line.status_code, resp.status_line.reason_phrase
+                )
+                ct = None
+                if resp.entity and resp.entity.content_type:
+                    ct = resp.entity.content_type.value
+                self.send_header("Content-Type", ct or "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path.rstrip("/") != f"/{outer.api_name}":
+                    self._send(_status(404, "Not Found"))
+                    return
+                exchange = _Exchange(self._read_request())
+                if outer.mode == "continuous":
+                    outer._score_now(exchange)
+                else:
+                    with outer._queue_lock:
+                        outer._queue.append((str(uuid.uuid4()), exchange))
+                        outer._queue_lock.notify()
+                if not exchange.event.wait(outer.request_timeout):
+                    self._send(_status(504, "Gateway Timeout"))
+                    return
+                self._send(exchange.response)
+
+            do_GET = do_POST
+            do_PUT = do_POST
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.host, self._port), Handler
+        )
+        self._httpd.daemon_threads = True
+        self._port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        if self.mode == "micro_batch":
+            self._engine_thread = threading.Thread(target=self._engine_loop, daemon=True)
+            self._engine_thread.start()
+        log.info("serving %s (%s mode)", self.url, self.mode)
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        with self._queue_lock:
+            pending = self._queue
+            self._queue = []
+            self._queue_lock.notify_all()
+        for _, ex in pending:
+            ex.respond(_status(503, "Service Unavailable"))
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    def __enter__(self) -> "ServingServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # - scoring --------------------------------------------------------------
+
+    def _run_batch(self, ids: List[str], exchanges: List[_Exchange]) -> None:
+        id_vals = np.empty(len(ids), object)
+        id_vals[:] = [{"requestId": rid, "partitionId": 0} for rid in ids]
+        reqs = np.empty(len(exchanges), object)
+        reqs[:] = [ex.request for ex in exchanges]
+        df = DataFrame.from_dict(
+            {"id": id_vals, "request": reqs},
+            types={"id": DataType.STRUCT, "request": DataType.STRUCT},
+        )
+        by_id = dict(zip(ids, exchanges))
+        try:
+            out = self.handler(df)
+            out_ids = out.column("id").values
+            replies = out.column(self.reply_col).values
+            for row_id, reply in zip(out_ids, replies):
+                rid = row_id["requestId"] if isinstance(row_id, dict) else str(row_id)
+                ex = by_id.pop(rid, None)
+                if ex is not None:
+                    ex.respond(reply if reply is not None else _status(500, "No reply"))
+        except Exception as e:  # surface pipeline errors as 500s, keep serving
+            log.exception("handler failed")
+            for ex in by_id.values():
+                ex.respond(
+                    _status(500, "Internal Server Error", repr(e).encode("utf-8"))
+                )
+            return
+        for ex in by_id.values():  # rows the handler dropped
+            ex.respond(_status(500, "No reply produced"))
+
+    def _score_now(self, exchange: _Exchange) -> None:
+        with self._model_lock:
+            self._run_batch([str(uuid.uuid4())], [exchange])
+
+    def _engine_loop(self) -> None:
+        while not self._stopping.is_set():
+            with self._queue_lock:
+                if not self._queue:
+                    self._queue_lock.wait(0.05)
+                    continue
+                deadline = time.monotonic() + self.max_wait_ms / 1000.0
+                while (
+                    len(self._queue) < self.max_batch_size
+                    and time.monotonic() < deadline
+                    and not self._stopping.is_set()
+                ):
+                    self._queue_lock.wait(max(0.0, deadline - time.monotonic()))
+                batch = self._queue[: self.max_batch_size]
+                self._queue = self._queue[self.max_batch_size:]
+            if batch:
+                ids = [rid for rid, _ in batch]
+                exchanges = [ex for _, ex in batch]
+                with self._model_lock:
+                    self._run_batch(ids, exchanges)
+
+
+def _status(code: int, reason: str, body: bytes = b"") -> HTTPResponseData:
+    return HTTPResponseData(
+        headers=[],
+        entity=EntityData(content=body, content_length=len(body)) if body else None,
+        status_line=StatusLineData(ProtocolVersionData(), code, reason),
+    )
+
+
+def serve_pipeline(
+    model,
+    input_schema: Any = None,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    api_name: str = "serving",
+    reply_col: str = "scored",
+    mode: str = "continuous",
+    **kwargs: Any,
+) -> ServingServer:
+    """One-liner: JSON request -> parse_request -> model.transform ->
+    make_reply(reply_col). `reply_col` must exist after the transform."""
+
+    def handler(df: DataFrame) -> DataFrame:
+        parsed = parse_request(df, input_schema)
+        scored = model.transform(parsed)
+        return make_reply(scored, reply_col)
+
+    return ServingServer(
+        handler, host=host, port=port, api_name=api_name, mode=mode, **kwargs
+    )

@@ -14,7 +14,7 @@ streaming versions have under a fully-buffered source.
 
 from __future__ import annotations
 
-from typing import List
+from typing import Any, List
 
 import numpy as np
 
@@ -138,25 +138,53 @@ class FlattenBatch(Transformer, Wrappable):
     def transform(self, df: DataFrame) -> DataFrame:
         if len(df) == 0:
             return df
-        cols = {}
+
+        def batch_len(r) -> int:
+            if isinstance(r, list):
+                return len(r)
+            if isinstance(r, np.ndarray) and r.ndim >= 1:
+                return len(r)
+            return -1  # scalar row: broadcast across the batch
+
+        # batch sizes come from the list-valued columns; scalar-valued
+        # columns (e.g. SimpleHTTPTransformer's per-batch error row — the
+        # reference's FlattenBatch asserts all-array and can't carry it)
+        # are broadcast to every element of their batch.
         counts = None
+        per_col_lens = {}
+        for name in df.columns:
+            rows = list(df.column(name).values)
+            lens = [batch_len(r) for r in rows]
+            per_col_lens[name] = (rows, lens)
+            if all(n >= 0 for n in lens):
+                if counts is None:
+                    counts = lens
+                elif lens != counts:
+                    raise ValueError(
+                        f"FlattenBatch: column {name!r} batch sizes {lens[:3]}... "
+                        f"differ from {counts[:3]}..."
+                    )
+        if counts is None:
+            raise ValueError("FlattenBatch: no list-valued columns to flatten")
+
+        cols = {}
         for name in df.columns:
             col = df.column(name)
-            rows = list(col.values)
-            lens = [len(np.asarray(r)) if not isinstance(r, list) else len(r) for r in rows]
-            if counts is None:
-                counts = lens
-            elif lens != counts:
-                raise ValueError(
-                    f"FlattenBatch: column {name!r} batch sizes {lens[:3]}... "
-                    f"differ from {counts[:3]}..."
-                )
-            if rows and isinstance(rows[0], np.ndarray):
-                flat = np.concatenate(rows) if rows else np.empty(0)
-                cols[name] = Column(flat, None, dict(col.metadata))
+            rows, lens = per_col_lens[name]
+            if all(n >= 0 for n in lens):
+                if rows and isinstance(rows[0], np.ndarray):
+                    flat: Any = np.concatenate(rows) if rows else np.empty(0)
+                    cols[name] = Column(flat, None, dict(col.metadata))
+                else:
+                    merged: list = []
+                    for r in rows:
+                        merged.extend(list(r))
+                    cols[name] = Column(merged, None, dict(col.metadata))
             else:
-                merged: list = []
-                for r in rows:
-                    merged.extend(list(r))
-                cols[name] = Column(merged, None, dict(col.metadata))
+                spread: list = []
+                for r, n in zip(rows, counts):
+                    spread.extend([r] * n)
+                arr = np.empty(len(spread), object)
+                arr[:] = spread
+                cols[name] = Column(arr, col.dtype, dict(col.metadata))
         return DataFrame(cols, df.num_partitions)
