@@ -50,6 +50,10 @@ class HTTPClientPool:
     def __init__(self, request_timeout: float = 60.0):
         self.request_timeout = request_timeout
         self._local = threading.local()
+        # every connection ever vended, so close() can reach the ones that
+        # live in OTHER threads' locals (async workers)
+        self._all_conns: List[http.client.HTTPConnection] = []
+        self._all_lock = threading.Lock()
 
     def _connections(self) -> dict:
         conns = getattr(self._local, "conns", None)
@@ -65,6 +69,8 @@ class HTTPClientPool:
             cls = http.client.HTTPSConnection if scheme == "https" else http.client.HTTPConnection
             conn = cls(netloc, timeout=self.request_timeout)
             conns[(scheme, netloc)] = conn
+            with self._all_lock:
+                self._all_conns.append(conn)
         return conn
 
     def execute(self, request: HTTPRequestData) -> HTTPResponseData:
@@ -106,7 +112,9 @@ class HTTPClientPool:
         )
 
     def close(self) -> None:
-        for conn in self._connections().values():
+        with self._all_lock:
+            conns, self._all_conns = self._all_conns, []
+        for conn in conns:
             conn.close()
         self._local.conns = {}
 
@@ -135,9 +143,10 @@ def send_with_retries(
                     (h.value for h in response.headers if h.name.lower() == "retry-after"),
                     None,
                 )
-                if retry_after is not None:
-                    log.info("429: waiting %ss on %s", retry_after, request.request_line.uri)
-                    time.sleep(float(retry_after))
+                delay = _parse_retry_after(retry_after)
+                if delay is not None:
+                    log.info("429: waiting %.1fs on %s", delay, request.request_line.uri)
+                    time.sleep(delay)
                 # 429 retries without consuming extra backoff beyond the schedule
             else:
                 log.warning(
@@ -150,6 +159,25 @@ def send_with_retries(
         assert last_exc is not None
         raise last_exc
     return response
+
+
+def _parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """Retry-After is delta-seconds OR an HTTP-date (RFC 7231 §7.1.3)."""
+    if value is None:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    try:
+        import email.utils
+
+        dt = email.utils.parsedate_to_datetime(value)
+        import datetime
+
+        return max(0.0, (dt - datetime.datetime.now(datetime.timezone.utc)).total_seconds())
+    except (TypeError, ValueError):
+        return None
 
 
 def advanced_handler(*retries_ms: int) -> HandlerFunc:
@@ -205,20 +233,16 @@ class AsyncHTTPClient:
         self, requests: Iterable[Optional[HTTPRequestData]]
     ) -> Iterator[Optional[HTTPResponseData]]:
         window: List = []
-        it = iter(requests)
-        try:
-            for req in it:
-                if req is None:
-                    window.append(None)
-                else:
-                    window.append(self._executor.submit(self.handler, self.pool, req))
-                if len(window) >= self.concurrency:
-                    head = window.pop(0)
-                    yield head.result(self.concurrent_timeout) if head is not None else None
-            for head in window:
+        for req in requests:
+            if req is None:
+                window.append(None)
+            else:
+                window.append(self._executor.submit(self.handler, self.pool, req))
+            if len(window) >= self.concurrency:
+                head = window.pop(0)
                 yield head.result(self.concurrent_timeout) if head is not None else None
-        finally:
-            pass
+        for head in window:
+            yield head.result(self.concurrent_timeout) if head is not None else None
 
     def close(self) -> None:
         self._executor.shutdown(wait=False)

@@ -152,7 +152,8 @@ class HTTPRequestData:
         """The JSONInputParser product: method+url+JSON entity
         (reference: Parsers.scala JSONInputParser.transform)."""
         hs = [HeaderData(k, v) for k, v in (headers or {}).items()]
-        hs.append(HeaderData("Content-type", "application/json"))
+        if not any(h.name.lower() == "content-type" for h in hs):
+            hs.append(HeaderData("Content-type", "application/json"))
         data = body.encode("utf-8")
         return HTTPRequestData(
             RequestLineData(method, url),

@@ -5,12 +5,15 @@ Semantics matched to the reference (see package docstring):
   HTTPRequestData] (HTTPSourceV2.scala ID_SCHEMA/SCHEMA at :88-99)
 - the sink routes each reply row's `reply` HTTPResponseData back to the
   exchange with that requestId (HTTPWriter, HTTPSourceV2.scala:421-476)
-- unanswered requests get 504s on shutdown; unknown routes get 404
+- unknown routes get 404; micro-batch requests that outlive
+  `request_timeout` get 504; requests pending at shutdown get 503
 - `parse_request` / `make_reply` mirror ServingImplicits.scala:90-109
 
 Continuous mode is the reference's "1 ms latency" HTTPSourceProviderV2
 path: no batch wait at all — the handler thread calls the pipeline
-directly (batch of 1) under a model lock.
+directly (batch of 1) under a model lock. Scoring runs inline, so
+`request_timeout` does not bound a slow model there — it only bounds the
+queue wait in micro-batch mode.
 """
 
 from __future__ import annotations
@@ -242,7 +245,8 @@ class ServingServer:
                 self.wfile.write(body)
 
             def do_POST(self):
-                if self.path.rstrip("/") != f"/{outer.api_name}":
+                route = self.path.split("?", 1)[0].rstrip("/")
+                if route != f"/{outer.api_name}":
                     self._send(_status(404, "Not Found"))
                     return
                 exchange = _Exchange(self._read_request())

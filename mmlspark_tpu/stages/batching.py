@@ -140,22 +140,28 @@ class FlattenBatch(Transformer, Wrappable):
             return df
 
         def batch_len(r) -> int:
-            if isinstance(r, list):
+            if isinstance(r, (list, tuple)):
                 return len(r)
             if isinstance(r, np.ndarray) and r.ndim >= 1:
                 return len(r)
             return -1  # scalar row: broadcast across the batch
 
-        # batch sizes come from the list-valued columns; scalar-valued
-        # columns (e.g. SimpleHTTPTransformer's per-batch error row — the
-        # reference's FlattenBatch asserts all-array and can't carry it)
-        # are broadcast to every element of their batch.
+        # batch sizes come from the sequence-valued columns; columns whose
+        # EVERY row is a scalar (e.g. SimpleHTTPTransformer's per-batch
+        # error row — the reference's FlattenBatch asserts all-array and
+        # can't carry it) are broadcast to every element of their batch.
+        # A column mixing sequence and scalar rows is ambiguous -> error.
         counts = None
         per_col_lens = {}
         for name in df.columns:
             rows = list(df.column(name).values)
             lens = [batch_len(r) for r in rows]
             per_col_lens[name] = (rows, lens)
+            if any(n >= 0 for n in lens) and any(n < 0 for n in lens):
+                raise ValueError(
+                    f"FlattenBatch: column {name!r} mixes batch rows and "
+                    "scalar rows"
+                )
             if all(n >= 0 for n in lens):
                 if counts is None:
                     counts = lens
