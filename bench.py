@@ -1,17 +1,31 @@
 """Benchmark entry point — run by the driver on real TPU hardware.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extras"}.
 
-Measures the BASELINE.json headline config: CIFAR10-shape ResNet-20 batch
+Headline metric (BASELINE.json configs[1]): CIFAR10-shape ResNet-20 batch
 inference through the full product path (DataFrame -> TPUModel.transform ->
 scores column), i.e. the CNTKModel CIFAR10 notebook flow
 (reference: CNTKModel.scala:469-516). Steady-state, compile excluded.
+
+extras carries the other measured configs:
+- gbdt_adult_fit_seconds / gbdt_adult_auc (BASELINE.json configs[0]):
+  LightGBMClassifier.fit on an Adult-Census-shaped dataset (48842 rows,
+  6 numeric + 8 categorical features, binary label), 100 iterations x 31
+  leaves — the reference's headline LightGBM config. AUC on a 20% holdout.
+- serving_p50_ms / serving_p99_ms: localhost continuous-mode serving
+  latency (reference claim: "as low as 1 ms", docs/mmlspark-serving.md).
 
 vs_baseline: the reference publishes no absolute numbers (SURVEY.md §6), so
 the bar is BASELINE.md's north star — ">= 1x V100 wall-clock". We use a
 nominal 6,000 imgs/sec for V100-era CNTK ResNet-20 batched eval (documented
 estimate in BASELINE.md; the reference's own per-row JNI path was far below
 this). vs_baseline = measured / 6000.
+
+NOTE (BASELINE.md round 3): the chip is reached through a dev tunnel whose
+host<->device bandwidth varies run to run (~20 MB/s to ~1.3 GB/s); the
+CIFAR number moves with it. Transfers are serialized (concurrent in-flight
+device_puts collapse tunnel throughput ~50x) and results are fetched once
+(per-fetch D2H latency ~100 ms).
 """
 
 import json
@@ -21,13 +35,14 @@ import time
 import numpy as np
 
 V100_CNTK_IMGS_PER_SEC = 6000.0  # documented estimate, see BASELINE.md
+CPU_LIGHTGBM_ADULT_SECONDS = 3.0  # documented estimate, see BASELINE.md
 
 N_IMAGES = 16384
 BATCH = 8192
 REPEATS = 3
 
 
-def main() -> int:
+def bench_cifar() -> float:
     import jax
 
     from mmlspark_tpu.core.dataframe import DataFrame
@@ -59,14 +74,136 @@ def main() -> int:
         dt = time.time() - t0
         best = max(best, N_IMAGES / dt)
     assert out["scores"].shape == (N_IMAGES, 10)
+    return best
+
+
+def make_adult_like(n: int = 48842, seed: int = 0):
+    """Synthetic dataset with the Adult-Census schema: 6 numeric + 8
+    categorical features, imbalanced binary label (~24% positive) with
+    signal in both feature kinds."""
+    rng = np.random.default_rng(seed)
+    age = rng.integers(17, 90, n).astype(np.float64)
+    fnlwgt = rng.lognormal(11.5, 0.7, n)
+    education_num = rng.integers(1, 17, n).astype(np.float64)
+    capital_gain = np.where(rng.random(n) < 0.08, rng.lognormal(8, 1.5, n), 0.0)
+    capital_loss = np.where(rng.random(n) < 0.05, rng.lognormal(7, 0.8, n), 0.0)
+    hours = np.clip(rng.normal(40, 12, n), 1, 99)
+    cats = {
+        "workclass": rng.integers(0, 9, n),
+        "education": rng.integers(0, 16, n),
+        "marital": rng.integers(0, 7, n),
+        "occupation": rng.integers(0, 15, n),
+        "relationship": rng.integers(0, 6, n),
+        "race": rng.integers(0, 5, n),
+        "sex": rng.integers(0, 2, n),
+        "country": rng.integers(0, 42, n),
+    }
+    logit = (
+        0.04 * (age - 38)
+        + 0.25 * (education_num - 10)
+        + 0.0004 * capital_gain
+        + 0.02 * (hours - 40)
+        + 0.35 * (cats["marital"] == 2)
+        + 0.3 * (cats["occupation"] % 4 == 1)
+        + 0.2 * cats["sex"]
+        - 1.9
+    )
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-logit))).astype(np.float64)
+    x = np.column_stack(
+        [age, fnlwgt, education_num, capital_gain, capital_loss, hours]
+        + [cats[k].astype(np.float64) for k in cats]
+    )
+    cat_idx = list(range(6, 14))
+    return x, y, cat_idx
+
+
+def bench_gbdt():
+    from mmlspark_tpu.core.dataframe import DataFrame, DataType
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+
+    x, y, cat_idx = make_adult_like()
+    n = len(y)
+    holdout = np.zeros(n, bool)
+    holdout[int(n * 0.8):] = True
+    df = DataFrame.from_dict({"features": x[~holdout], "label": y[~holdout]})
+
+    def fit_once():
+        clf = LightGBMClassifier(
+            num_iterations=100,
+            num_leaves=31,
+            max_bin=255,
+            categorical_slot_indexes=cat_idx,
+            verbosity=0,
+        )
+        return clf.fit(df)
+
+    fit_once()  # compile warmup: jit kernels cache across fits
+    t0 = time.time()
+    model = fit_once()
+    fit_seconds = time.time() - t0
+
+    test = DataFrame.from_dict({"features": x[holdout]})
+    p = model.transform(test)["probability"][:, 1]
+    yt = y[holdout]
+    order = np.argsort(p)
+    ranks = np.empty(n - int(n * 0.8))
+    ranks[order] = np.arange(1, len(p) + 1)
+    pos = yt > 0
+    auc = (ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2) / (
+        pos.sum() * (~pos).sum()
+    )
+    return fit_seconds, float(auc)
+
+
+def bench_serving():
+    import http.client
+
+    from mmlspark_tpu.core.dataframe import DataType
+    from mmlspark_tpu.serving import ServingServer, make_reply, parse_request
+
+    def handler(df):
+        parsed = parse_request(df)
+        vals = np.asarray([float(v) for v in parsed["x"]])
+        return make_reply(
+            parsed.with_column("y", vals * 2.0, DataType.DOUBLE), "y"
+        )
+
+    with ServingServer(handler, api_name="bench") as server:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+        lat = []
+        for i in range(500):
+            body = json.dumps({"x": i}).encode()
+            t0 = time.perf_counter()
+            conn.request("POST", "/bench", body, {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            r.read()
+            lat.append(time.perf_counter() - t0)
+        conn.close()
+    lat = sorted(lat[50:])
+    return lat[len(lat) // 2] * 1000, lat[int(len(lat) * 0.99)] * 1000
+
+
+def main() -> int:
+    imgs_per_sec = bench_cifar()
+    gbdt_seconds, gbdt_auc = bench_gbdt()
+    p50, p99 = bench_serving()
 
     print(
         json.dumps(
             {
                 "metric": "cifar10_resnet20_inference",
-                "value": round(best, 1),
+                "value": round(imgs_per_sec, 1),
                 "unit": "imgs/sec/chip",
-                "vs_baseline": round(best / V100_CNTK_IMGS_PER_SEC, 3),
+                "vs_baseline": round(imgs_per_sec / V100_CNTK_IMGS_PER_SEC, 3),
+                "extras": {
+                    "gbdt_adult_fit_seconds": round(gbdt_seconds, 2),
+                    "gbdt_adult_fit_vs_cpu_baseline": round(
+                        CPU_LIGHTGBM_ADULT_SECONDS / gbdt_seconds, 3
+                    ),
+                    "gbdt_adult_auc": round(gbdt_auc, 4),
+                    "serving_p50_ms": round(p50, 3),
+                    "serving_p99_ms": round(p99, 3),
+                },
             }
         )
     )

@@ -64,6 +64,357 @@ def add_leaf_outputs(raw, assign, leaf_values):
     return raw + leaf_values[assign]
 
 
+def _hist_masked(bins, grad, hess, mask, num_bins: int):
+    """(F, B, 3) histogram over masked rows — leaf_histogram's body, usable
+    inside a larger jit program."""
+    import jax.numpy as jnp
+
+    n, f = bins.shape
+    g = jnp.where(mask, grad, 0.0).astype(jnp.float32)
+    h = jnp.where(mask, hess, 0.0).astype(jnp.float32)
+    c = mask.astype(jnp.float32)
+    idx = bins + jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins
+    updates = jnp.stack(
+        [jnp.broadcast_to(g[:, None], (n, f)),
+         jnp.broadcast_to(h[:, None], (n, f)),
+         jnp.broadcast_to(c[:, None], (n, f))],
+        axis=-1,
+    )
+    flat = jnp.zeros((f * num_bins, 3), jnp.float32)
+    flat = flat.at[idx.reshape(-1)].add(updates.reshape(-1, 3))
+    return flat.reshape(f, num_bins, 3)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_bins", "num_leaves", "depth_limit", "max_cat_threshold",
+    ),
+)
+def grow_tree_fused(
+    bins,            # (n, F) int32
+    grad,            # (n,) f32
+    hess,            # (n,) f32
+    sample_mask,     # (n,) bool
+    n_bins_arr,      # (F,) int32
+    categorical_arr, # (F,) bool
+    feature_mask,    # (F,) bool
+    min_data, min_hess, l1, l2, min_gain, learning_rate,  # traced f32 scalars
+    *,
+    num_bins: int,
+    num_leaves: int,
+    depth_limit: int,
+    max_cat_threshold: int,
+):
+    """Grow ONE leaf-wise tree entirely on device — the SURVEY §7 "fused
+    kernels" design. The host grower's per-split device round trip
+    (histogram fetch -> host split finder -> row routing) costs ~100 ms of
+    transfer latency per split through the chip tunnel, i.e. seconds per
+    tree; this program runs the whole best-first loop (num_leaves-1 fixed
+    iterations with masked no-ops after convergence) in one dispatch and
+    returns a single packed f32 buffer.
+
+    Semantics match tree.find_best_split/grow_tree (LightGBM
+    SerialTreeLearner): leaf-wise argmax-gain growth, sibling histogram
+    subtraction, numerical splits over cumulative bins (missing bin 0
+    left), sorted-categorical prefix scans from both ends, min_data /
+    min_hessian / min_gain / depth constraints. Arithmetic is f32 on
+    device (the host path computed gains in f64), so split choices can
+    differ from the host grower in near-ties; sharded-vs-single
+    determinism is unaffected because every device count runs this same
+    program with a replicated histogram reduction.
+
+    Returns (packed, leaf_values, assign):
+      packed: flat f32 —
+        [num_nodes, num_leaves_used,
+         feat(L), thr_bin(L), is_cat(L), gain(L), internal_value(L),
+         internal_count(L), left_child(L), right_child(L),
+         member(L*B) row-major, leaf_value(L), leaf_count(L)]
+        child entries >= 0 are node ids, negative are ~leaf_index.
+      leaf_values: (L,) f32 shrunk leaf outputs (for the raw-score update)
+      assign: (n,) int32 final leaf index per row
+    """
+    import jax.numpy as jnp
+
+    F = bins.shape[1]
+    B = num_bins
+    L = num_leaves
+    NEG = jnp.float32(-jnp.inf)
+
+    def thresh(g):
+        return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
+
+    def score(g, h):
+        t = thresh(g)
+        return t * t / jnp.maximum(h + l2, 1e-35)
+
+    def leaf_out(g, h):
+        return -thresh(g) / jnp.maximum(h + l2, 1e-35)
+
+    def best_split(hist, depth_ok):
+        """hist (F,B,3) -> (gain, feat, thr_bin, is_cat, member(B,),
+        left(3,), right(3,)). gain=-inf when no valid split."""
+        g, h, c = hist[..., 0], hist[..., 1], hist[..., 2]
+        tg, th, tc = g.sum(1), h.sum(1), c.sum(1)          # (F,)
+        parent = score(tg, th)
+        leaf_ok = (tc >= 2.0 * min_data) & feature_mask & depth_ok
+
+        # -- numerical: left = bins [0..t], t in [1, nb-2] ------------------
+        cg, ch, cc = jnp.cumsum(g, 1), jnp.cumsum(h, 1), jnp.cumsum(c, 1)
+        tpos = jnp.arange(B)[None, :]
+        gl, hl, cl = cg, ch, cc
+        gr, hr, cr = tg[:, None] - gl, th[:, None] - hl, tc[:, None] - cl
+        nvalid = (
+            (tpos >= 1)
+            & (tpos <= n_bins_arr[:, None] - 2)
+            & (cl >= min_data) & (cr >= min_data)
+            & (hl >= min_hess) & (hr >= min_hess)
+            & (~categorical_arr)[:, None]
+            & leaf_ok[:, None]
+        )
+        ngain = jnp.where(
+            nvalid, score(gl, hl) + score(gr, hr) - parent[:, None], NEG
+        )
+        nbest_t = jnp.argmax(ngain, axis=1)                 # (F,) first max
+        nbest_gain = jnp.take_along_axis(ngain, nbest_t[:, None], 1)[:, 0]
+
+        # -- categorical: sorted by g/h ratio, both directions --------------
+        bpos = jnp.arange(B)
+        present = (c > 0) & (bpos[None, :] >= 1) & (bpos[None, :] < n_bins_arr[:, None])
+        ratio = g / (h + l2 + 1e-12)
+        kcats = present.sum(1)                              # (F,)
+        lim = jnp.minimum(kcats - 1, max_cat_threshold)
+
+        def one_dir(key):
+            order = jnp.argsort(key, axis=1)                # (F, B) stable
+            g_s = jnp.take_along_axis(g, order, 1)
+            h_s = jnp.take_along_axis(h, order, 1)
+            c_s = jnp.take_along_axis(c, order, 1)
+            cgl = jnp.cumsum(g_s, 1)
+            chl = jnp.cumsum(h_s, 1)
+            ccl = jnp.cumsum(c_s, 1)
+            cgr = tg[:, None] - cgl
+            chr_ = th[:, None] - chl
+            ccr = tc[:, None] - ccl
+            jpos = jnp.arange(B)[None, :]
+            cvalid = (
+                (jpos < lim[:, None])
+                & (ccl >= min_data) & (ccr >= min_data)
+                & (chl >= min_hess) & (chr_ >= min_hess)
+                & categorical_arr[:, None]
+                & leaf_ok[:, None]
+            )
+            cgain = jnp.where(
+                cvalid, score(cgl, chl) + score(cgr, chr_) - parent[:, None], NEG
+            )
+            jbest = jnp.argmax(cgain, axis=1)
+            return order, jbest, jnp.take_along_axis(cgain, jbest[:, None], 1)[:, 0]
+
+        inf = jnp.float32(jnp.inf)
+        key_asc = jnp.where(present, ratio, inf)
+        key_desc = jnp.where(present, -ratio, inf)
+        o1, j1, g1 = one_dir(key_asc)
+        o2, j2, g2 = one_dir(key_desc)
+        use2 = g2 > g1                                      # strict, host parity
+        corder = jnp.where(use2[:, None], o2, o1)
+        cj = jnp.where(use2, j2, j1)
+        cbest_gain = jnp.maximum(g1, g2)
+
+        # -- combine per feature, then first-argmax over features -----------
+        fgain = jnp.maximum(nbest_gain, cbest_gain)
+        use_cat_f = cbest_gain > nbest_gain
+        f_star = jnp.argmax(fgain)
+        gain = fgain[f_star]
+        is_cat = use_cat_f[f_star] & categorical_arr[f_star]
+        t_star = nbest_t[f_star]
+        # member mask, True = left
+        num_member = jnp.arange(B) <= t_star
+        ranks = jnp.zeros(B, jnp.int32).at[corder[f_star]].set(jnp.arange(B, dtype=jnp.int32))
+        cat_member = ranks <= cj[f_star]
+        member = jnp.where(is_cat, cat_member, num_member)
+        # left stats at the chosen cut
+        def stats_at(cum_gl, cum_hl, cum_cl, idx):
+            return jnp.stack([cum_gl[f_star, idx], cum_hl[f_star, idx], cum_cl[f_star, idx]])
+
+        g_s = jnp.take_along_axis(g, corder, 1)
+        h_s = jnp.take_along_axis(h, corder, 1)
+        c_s = jnp.take_along_axis(c, corder, 1)
+        left_num = stats_at(cg, ch, cc, t_star)
+        left_cat = stats_at(jnp.cumsum(g_s, 1), jnp.cumsum(h_s, 1), jnp.cumsum(c_s, 1), cj[f_star])
+        left = jnp.where(is_cat, left_cat, left_num)
+        total = jnp.stack([tg[f_star], th[f_star], tc[f_star]])
+        right = total - left
+        thr_bin = jnp.where(is_cat, -1, t_star).astype(jnp.int32)
+        return gain, f_star.astype(jnp.int32), thr_bin, is_cat, member, left, right
+
+    # -- root ----------------------------------------------------------------
+    hist0 = _hist_masked(bins, grad, hess, sample_mask, B)
+    root_stats = jnp.stack([hist0[0, :, 0].sum(), hist0[0, :, 1].sum(), hist0[0, :, 2].sum()])
+    depth_ok0 = jnp.asarray(0 < depth_limit)
+    bg0, bf0, bt0, bic0, bm0, bl0, br0 = best_split(hist0, depth_ok0)
+
+    state = dict(
+        assign=jnp.zeros(bins.shape[0], jnp.int32),
+        hists=jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist0),
+        stats=jnp.zeros((L, 3), jnp.float32).at[0].set(root_stats),
+        depths=jnp.zeros(L, jnp.int32),
+        best_gain=jnp.full(L, NEG).at[0].set(bg0),
+        best_feat=jnp.zeros(L, jnp.int32).at[0].set(bf0),
+        best_bin=jnp.zeros(L, jnp.int32).at[0].set(bt0),
+        best_is_cat=jnp.zeros(L, bool).at[0].set(bic0),
+        best_member=jnp.zeros((L, B), bool).at[0].set(bm0),
+        best_left=jnp.zeros((L, 3), jnp.float32).at[0].set(bl0),
+        best_right=jnp.zeros((L, 3), jnp.float32).at[0].set(br0),
+        node_feat=jnp.zeros(L, jnp.int32),
+        node_bin=jnp.zeros(L, jnp.int32),
+        node_is_cat=jnp.zeros(L, bool),
+        node_gain=jnp.zeros(L, jnp.float32),
+        node_value=jnp.zeros(L, jnp.float32),
+        node_count=jnp.zeros(L, jnp.int32),
+        node_left=jnp.full(L, -(2 ** 30), jnp.int32),
+        node_right=jnp.full(L, -(2 ** 30), jnp.int32),
+        node_member=jnp.zeros((L, B), bool),
+        slot_parent=jnp.full(L, -1, jnp.int32),
+        slot_side=jnp.zeros(L, jnp.int32),
+        n_leaves=jnp.int32(1),
+        n_nodes=jnp.int32(0),
+        done=jnp.asarray(False),
+        step=jnp.int32(0),
+    )
+
+    gain_floor = jnp.maximum(min_gain, 0.0)
+
+    def body(st):
+        s = jnp.argmax(st["best_gain"]).astype(jnp.int32)
+        do = (~st["done"]) & (st["best_gain"][s] > gain_floor)
+
+        def sel(new, old):
+            return jnp.where(do, new, old)
+
+        node_id = st["n_nodes"]
+        new_slot = st["n_leaves"]
+
+        # record node (writes masked by `do` via sel on the whole array)
+        st["node_feat"] = sel(st["node_feat"].at[node_id].set(st["best_feat"][s]), st["node_feat"])
+        st["node_bin"] = sel(st["node_bin"].at[node_id].set(st["best_bin"][s]), st["node_bin"])
+        st["node_is_cat"] = sel(st["node_is_cat"].at[node_id].set(st["best_is_cat"][s]), st["node_is_cat"])
+        st["node_gain"] = sel(st["node_gain"].at[node_id].set(st["best_gain"][s]), st["node_gain"])
+        st["node_value"] = sel(
+            st["node_value"].at[node_id].set(leaf_out(st["stats"][s, 0], st["stats"][s, 1])),
+            st["node_value"],
+        )
+        st["node_count"] = sel(
+            st["node_count"].at[node_id].set(st["stats"][s, 2].astype(jnp.int32)),
+            st["node_count"],
+        )
+        st["node_member"] = sel(st["node_member"].at[node_id].set(st["best_member"][s]), st["node_member"])
+
+        # patch parent pointer (skip for root: parent == -1 -> drop)
+        p = st["slot_parent"][s]
+        side = st["slot_side"][s]
+        lidx = jnp.where(do & (p >= 0) & (side == 0), p, L + 7)
+        ridx = jnp.where(do & (p >= 0) & (side == 1), p, L + 7)
+        st["node_left"] = st["node_left"].at[lidx].set(node_id, mode="drop")
+        st["node_right"] = st["node_right"].at[ridx].set(node_id, mode="drop")
+        st["slot_parent"] = sel(
+            st["slot_parent"].at[s].set(node_id).at[new_slot].set(node_id),
+            st["slot_parent"],
+        )
+        st["slot_side"] = sel(
+            st["slot_side"].at[s].set(0).at[new_slot].set(1), st["slot_side"]
+        )
+
+        # route rows: member True = stay left (slot s), else new_slot
+        fcol = jnp.take(bins, st["best_feat"][s], axis=1)
+        go_left = st["best_member"][s][fcol]
+        st["assign"] = sel(
+            jnp.where((st["assign"] == s) & ~go_left, new_slot, st["assign"]).astype(jnp.int32),
+            st["assign"],
+        )
+
+        # child histograms: scatter the SMALLER child, subtract for sibling
+        lcnt = st["best_left"][s, 2]
+        rcnt = st["best_right"][s, 2]
+        small_is_left = lcnt <= rcnt
+        small_slot = jnp.where(small_is_left, s, new_slot)
+        small_hist = _hist_masked(
+            bins, grad, hess, sample_mask & (st["assign"] == small_slot), B
+        )
+        big_hist = st["hists"][s] - small_hist
+        left_hist = jnp.where(small_is_left, small_hist, big_hist)
+        right_hist = jnp.where(small_is_left, big_hist, small_hist)
+        st["hists"] = sel(
+            st["hists"].at[s].set(left_hist).at[new_slot].set(right_hist),
+            st["hists"],
+        )
+        st["stats"] = sel(
+            st["stats"].at[s].set(st["best_left"][s]).at[new_slot].set(st["best_right"][s]),
+            st["stats"],
+        )
+        depth = st["depths"][s] + 1
+        st["depths"] = sel(
+            st["depths"].at[s].set(depth).at[new_slot].set(depth), st["depths"]
+        )
+
+        # recompute best splits for the two children (one vmapped instance
+        # of best_split keeps the compiled program half the size)
+        depth_ok = depth < depth_limit
+        cg_, cf_, ct_, cic_, cm_, cl_, cr_ = jax.vmap(
+            lambda hh: best_split(hh, depth_ok)
+        )(jnp.stack([left_hist, right_hist]))
+        st["best_gain"] = sel(st["best_gain"].at[s].set(cg_[0]).at[new_slot].set(cg_[1]), st["best_gain"])
+        st["best_feat"] = sel(st["best_feat"].at[s].set(cf_[0]).at[new_slot].set(cf_[1]), st["best_feat"])
+        st["best_bin"] = sel(st["best_bin"].at[s].set(ct_[0]).at[new_slot].set(ct_[1]), st["best_bin"])
+        st["best_is_cat"] = sel(st["best_is_cat"].at[s].set(cic_[0]).at[new_slot].set(cic_[1]), st["best_is_cat"])
+        st["best_member"] = sel(st["best_member"].at[s].set(cm_[0]).at[new_slot].set(cm_[1]), st["best_member"])
+        st["best_left"] = sel(st["best_left"].at[s].set(cl_[0]).at[new_slot].set(cl_[1]), st["best_left"])
+        st["best_right"] = sel(st["best_right"].at[s].set(cr_[0]).at[new_slot].set(cr_[1]), st["best_right"])
+
+        st["n_leaves"] = sel(st["n_leaves"] + 1, st["n_leaves"])
+        st["n_nodes"] = sel(st["n_nodes"] + 1, st["n_nodes"])
+        st["done"] = st["done"] | ~do
+        st["step"] = st["step"] + 1
+        return st
+
+    # while_loop (not fori): a tree that converges at 5 leaves must not pay
+    # for num_leaves-1 full-data histogram steps of masked no-ops
+    state = jax.lax.while_loop(
+        lambda st: (st["step"] < L - 1) & ~st["done"], body, state
+    )
+
+    # -- finalize ------------------------------------------------------------
+    slots = jnp.arange(L)
+    live = slots < state["n_leaves"]
+    leaf_values = jnp.where(
+        live, leaf_out(state["stats"][:, 0], state["stats"][:, 1]) * learning_rate, 0.0
+    ).astype(jnp.float32)
+    leaf_counts = jnp.where(live, state["stats"][:, 2], 0.0)
+
+    # patch leaf references (~slot) into the child arrays
+    pmask = live & (state["slot_parent"] >= 0)
+    lpatch = jnp.where(pmask & (state["slot_side"] == 0), state["slot_parent"], L + 7)
+    rpatch = jnp.where(pmask & (state["slot_side"] == 1), state["slot_parent"], L + 7)
+    node_left = state["node_left"].at[lpatch].set(~slots, mode="drop")
+    node_right = state["node_right"].at[rpatch].set(~slots, mode="drop")
+
+    packed = jnp.concatenate([
+        jnp.stack([state["n_nodes"].astype(jnp.float32),
+                   state["n_leaves"].astype(jnp.float32)]),
+        state["node_feat"].astype(jnp.float32),
+        state["node_bin"].astype(jnp.float32),
+        state["node_is_cat"].astype(jnp.float32),
+        state["node_gain"],
+        state["node_value"],
+        state["node_count"].astype(jnp.float32),
+        node_left.astype(jnp.float32),
+        node_right.astype(jnp.float32),
+        state["node_member"].astype(jnp.float32).reshape(-1),
+        leaf_values,
+        leaf_counts,
+    ])
+    return packed, leaf_values, state["assign"]
+
+
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def walk_trees_binned(bins, feats, members, lefts, rights, is_leaf, values,
                       *, max_depth: int):

@@ -27,12 +27,33 @@ from mmlspark_tpu.core.config import get_logger
 from mmlspark_tpu.gbdt.binning import BinMapper
 from mmlspark_tpu.gbdt.booster import Booster
 from mmlspark_tpu.gbdt.objectives import Objective
-from mmlspark_tpu.gbdt.tree import GrowConfig, Tree, grow_tree
+from mmlspark_tpu.gbdt.tree import (
+    GrowConfig,
+    Tree,
+    grow_tree_packed,
+    unpack_tree,
+)
 
 
 # Test hook: force the unsharded single-device path even on a multi-device
 # host, so device-count-invariance (identical trees) can be asserted.
 _FORCE_SINGLE_DEVICE = False
+
+
+class _DeferredTree:
+    """A grown tree still living on device as grow_tree_fused's packed
+    buffer; fetched+decoded once at the end of the fit."""
+
+    __slots__ = ("packed",)
+
+    def __init__(self, packed):
+        self.packed = packed
+
+    def materialize(self, cfg: "GrowConfig", num_bins: int, threshold_value_fn) -> Tree:
+        return unpack_tree(
+            np.asarray(self.packed), cfg.num_leaves, num_bins,
+            threshold_value_fn, cfg,
+        )
 
 
 @dataclasses.dataclass
@@ -103,38 +124,47 @@ def train_booster(
     # Data-parallel sharding: with >1 device, row-dim arrays shard over the
     # mesh "data" axis; the histogram scatter's replicated output makes XLA
     # emit the cross-chip psum (the reference's native allreduce ring).
+    #
+    # Rows always pad up to a 1024 block (masked out of every histogram):
+    # the fused grower compiles per row-count, so quantizing n means one
+    # compiled program serves every dataset in the block — and since
+    # nd | 1024 the padded size is device-count-invariant, keeping bagging
+    # draws identical across mesh sizes.
     n_orig = n
     y_host = np.asarray(y, np.float64)
+    import math
+
     if jax.device_count() > 1 and not _FORCE_SINGLE_DEVICE:
         from mmlspark_tpu.parallel.mesh import batch_sharding, data_parallel_mesh
 
         mesh = data_parallel_mesh()
         nd = mesh.shape["data"]
-        pad = (-n) % nd
-        if pad:  # zero-weight pad rows so every chip gets an equal slice
-            bins = np.concatenate([bins, np.zeros((pad, f), bins.dtype)])
-            y = np.concatenate([y, np.zeros(pad, y.dtype)])
-            x = np.concatenate([x, np.zeros((pad, f), x.dtype)])
-            if sample_weight is not None:
-                sample_weight = np.concatenate(
-                    [sample_weight, np.zeros(pad, np.float64)]
-                )
-            train_rows = np.concatenate([train_rows, np.zeros(pad, bool)])
-            if init_raw is not None:
-                init_raw = np.concatenate(
-                    [init_raw, np.zeros((pad,) + init_raw.shape[1:], init_raw.dtype)]
-                )
-            n += pad
 
         def shard(a):
             a = np.asarray(a)
             return jax.device_put(a, batch_sharding(mesh, a.ndim))
 
     else:
+        nd = 1
         shard = jax.device_put
 
+    pad = (-n) % math.lcm(1024, nd)
+    if pad:  # zero-weight pad rows, excluded from train_rows everywhere
+        bins = np.concatenate([bins, np.zeros((pad, f), bins.dtype)])
+        y = np.concatenate([y, np.zeros(pad, y.dtype)])
+        x = np.concatenate([x, np.zeros((pad, f), x.dtype)])
+        if sample_weight is not None:
+            sample_weight = np.concatenate(
+                [sample_weight, np.zeros(pad, np.float64)]
+            )
+        train_rows = np.concatenate([train_rows, np.zeros(pad, bool)])
+        if init_raw is not None:
+            init_raw = np.concatenate(
+                [init_raw, np.zeros((pad,) + init_raw.shape[1:], init_raw.dtype)]
+            )
+        n += pad
+
     bins_dev = shard(bins.astype(np.int32))
-    feature_cols = [bins_dev[:, j] for j in range(f)]
     y_dev = shard(np.asarray(y, np.float32))
     w_dev = (
         shard(np.asarray(sample_weight, np.float32))
@@ -197,9 +227,15 @@ def train_booster(
 
     grad_fn = jax.jit(grads)
 
+    # device-resident grower inputs, uploaded once and reused every tree
+    n_bins_dev = jnp.asarray(np.asarray(binner.n_bins, np.int32))
+    cat_dev = jnp.asarray(np.asarray(categorical, bool))
+    full_fmask_dev = jnp.asarray(np.ones(f, bool))
+    num_bins_static = int(max(binner.n_bins))
+
     rng = np.random.default_rng(cfg.bagging_seed)
     frng = np.random.default_rng(cfg.bagging_seed + 17)
-    trees: List[Tree] = list(init_model.trees) if init_model is not None else []
+    trees: List[Any] = list(init_model.trees) if init_model is not None else []
     start_iter = len(trees) // k
     bag_mask = train_rows.copy()
     use_bagging = (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0) or rf_mode
@@ -296,13 +332,17 @@ def train_booster(
         mask_dev = jax.device_put(bag_mask) if (use_bagging or goss_mode) else train_mask_dev
 
         # -- grow k trees -------------------------------------------------------
-        new_trees: List[Tree] = []
-        feature_mask = None
+        # dart must materialize host trees immediately (drop bookkeeping
+        # rescales past trees); other modes defer the packed-buffer fetch
+        # to the end of the fit — zero per-iteration D2H.
+        new_trees: List[Any] = []
+        fmask_dev = full_fmask_dev
         if cfg.feature_fraction < 1.0:
             n_keep = max(1, int(np.ceil(cfg.feature_fraction * f)))
             keep = frng.choice(f, size=n_keep, replace=False)
             feature_mask = np.zeros(f, bool)
             feature_mask[keep] = True
+            fmask_dev = jax.device_put(feature_mask)
 
         for c in range(k):
             gc = g_dev[:, c] if k > 1 else g_dev
@@ -310,17 +350,23 @@ def train_booster(
             if sample_amp is not None:
                 gc = gc * sample_amp
                 hc = hc * sample_amp
-            assign = shard(np.zeros(n, np.int32))
-            tree, assign = grow_tree(
-                bins_dev, feature_cols, gc, hc, mask_dev, assign,
-                binner.n_bins, categorical, binner.threshold_value,
-                grow_cfg, feature_mask,
+            packed, leaf_vals, assign = grow_tree_packed(
+                bins_dev, gc, hc, mask_dev,
+                n_bins_dev, cat_dev, fmask_dev,
+                num_bins_static, grow_cfg,
             )
-            if dart_mode and dropped:
-                norm = 1.0 / (len(dropped) + 1)
-                tree.leaf_value = [v * norm for v in tree.leaf_value]
-            new_trees.append(tree)
-            leaf_vals = jnp.asarray(np.asarray(tree.leaf_value, np.float32))
+            if dart_mode:
+                tree = unpack_tree(
+                    np.asarray(packed), grow_cfg.num_leaves,
+                    num_bins_static, binner.threshold_value, grow_cfg,
+                )
+                if dropped:
+                    norm = 1.0 / (len(dropped) + 1)
+                    tree.leaf_value = [v * norm for v in tree.leaf_value]
+                    leaf_vals = leaf_vals * np.float32(norm)
+                new_trees.append(tree)
+            else:
+                new_trees.append(_DeferredTree(packed))
             if k > 1:
                 raw = raw.at[:, c].add(leaf_vals[assign])
             else:
@@ -367,6 +413,12 @@ def train_booster(
                 trees = trees[: (best_iter + 1) * k]
                 break
 
+    trees = [
+        t.materialize(grow_cfg, num_bins_static, binner.threshold_value)
+        if isinstance(t, _DeferredTree)
+        else t
+        for t in trees
+    ]
     return Booster(
         trees,
         objective.kind,

@@ -209,7 +209,141 @@ class Tree:
             node = nxt
 
 
+def grow_tree_packed(
+    bins_dev,
+    grad_dev,
+    hess_dev,
+    sample_mask_dev,
+    n_bins_dev,       # (F,) int32 device (cache across iterations!)
+    categorical_dev,  # (F,) bool device
+    feature_mask_dev, # (F,) bool device
+    num_bins: int,
+    cfg: GrowConfig,
+):
+    """Device-only tree growth: ONE dispatch, nothing fetched. Returns
+    (packed_device, assign_device, leaf_values_device); decode the packed
+    buffer later with unpack_tree (typically once per fit, at the end —
+    each fetch costs ~100 ms of D2H latency on remote-attached chips)."""
+    from mmlspark_tpu.gbdt.compute import grow_tree_fused
+
+    L = int(cfg.num_leaves)
+    return grow_tree_fused(
+        bins_dev,
+        grad_dev,
+        hess_dev,
+        sample_mask_dev,
+        n_bins_dev,
+        categorical_dev,
+        feature_mask_dev,
+        np.float32(cfg.min_data_in_leaf),
+        np.float32(cfg.min_sum_hessian_in_leaf),
+        np.float32(cfg.lambda_l1),
+        np.float32(cfg.lambda_l2),
+        np.float32(cfg.min_gain_to_split),
+        np.float32(cfg.learning_rate),
+        num_bins=num_bins,
+        num_leaves=L,
+        depth_limit=int(cfg.max_depth) if cfg.max_depth > 0 else L,
+        max_cat_threshold=int(cfg.max_cat_threshold),
+    )
+
+
 def grow_tree(
+    bins_dev,
+    grad_dev,
+    hess_dev,
+    sample_mask_dev,
+    n_bins: Sequence[int],
+    categorical: Sequence[bool],
+    threshold_value_fn,
+    cfg: GrowConfig,
+    feature_mask: Optional[np.ndarray] = None,
+) -> Tuple[Tree, Any, Any]:
+    """Grow one tree in a single fused device program (compute.py
+    grow_tree_fused) and unpack the result: ONE dispatch + ONE small D2H
+    per tree, vs the host grower's round trip per split (which costs
+    ~100 ms tunnel latency each — seconds per tree on remote-attached
+    chips). Returns (tree, final_assign_device, leaf_values_device).
+    """
+    import jax.numpy as jnp
+
+    F = bins_dev.shape[1]
+    num_bins = int(max(n_bins))
+    fm = (
+        np.ones(F, bool)
+        if feature_mask is None
+        else np.asarray(feature_mask, bool)
+    )
+    packed, leaf_vals, assign = grow_tree_packed(
+        bins_dev, grad_dev, hess_dev, sample_mask_dev,
+        jnp.asarray(np.asarray(n_bins, np.int32)),
+        jnp.asarray(np.asarray(categorical, bool)),
+        jnp.asarray(fm),
+        num_bins, cfg,
+    )
+    tree = unpack_tree(
+        np.asarray(packed), int(cfg.num_leaves), num_bins,
+        threshold_value_fn, cfg,
+    )
+    return tree, assign, leaf_vals
+
+
+def unpack_tree(
+    packed: np.ndarray, L: int, B: int, threshold_value_fn, cfg: GrowConfig
+) -> Tree:
+    """Decode grow_tree_fused's flat f32 buffer into a host Tree."""
+    nn = int(packed[0])
+    nl = int(packed[1])
+    off = 2
+
+    def take(k):
+        nonlocal off
+        out = packed[off : off + k]
+        off += k
+        return out
+
+    feat = take(L).astype(np.int64)
+    thr_bin = take(L).astype(np.int64)
+    is_cat = take(L) > 0.5
+    gain = take(L)
+    ivalue = take(L)
+    icount = take(L).astype(np.int64)
+    lchild = take(L).astype(np.int64)
+    rchild = take(L).astype(np.int64)
+    member = (take(L * B) > 0.5).reshape(L, B)
+    leaf_value = take(L)
+    leaf_count = take(L).astype(np.int64)
+
+    tree = Tree()
+    tree.shrinkage = cfg.learning_rate
+    for i in range(nn):
+        f = int(feat[i])
+        tree.split_feature.append(f)
+        tree.split_gain.append(float(gain[i]))
+        tree.internal_value.append(float(ivalue[i]))
+        tree.internal_count.append(int(icount[i]))
+        tree.left_child.append(int(lchild[i]))
+        tree.right_child.append(int(rchild[i]))
+        if is_cat[i]:
+            tree.is_categorical.append(True)
+            tree.threshold_bin.append(-1)
+            tree.threshold_value.append(0.0)
+            # bins are category value + 1 (binning.py); bin 0 = missing
+            tree.cat_left.append(
+                sorted(int(b) - 1 for b in np.nonzero(member[i])[0] if b >= 1)
+            )
+        else:
+            tb = int(thr_bin[i])
+            tree.is_categorical.append(False)
+            tree.threshold_bin.append(tb)
+            tree.threshold_value.append(threshold_value_fn(f, tb))
+            tree.cat_left.append(None)
+    tree.leaf_value = [float(v) for v in leaf_value[:nl]]
+    tree.leaf_count = [int(c) for c in leaf_count[:nl]]
+    return tree
+
+
+def grow_tree_host(
     bins_dev,
     feature_cols_dev: list,
     grad_dev,
@@ -222,7 +356,11 @@ def grow_tree(
     cfg: GrowConfig,
     feature_mask: Optional[np.ndarray] = None,
 ) -> Tuple[Tree, Any]:
-    """Grow one tree. Returns (tree, final_assign_device).
+    """Host-driven reference grower (one device round trip per split).
+
+    Kept as the readable reference implementation the fused kernel is
+    tested against (tests/test_gbdt.py device-vs-host parity); production
+    training uses grow_tree above. Returns (tree, final_assign_device).
 
     bins_dev: (n, F) int32 on device; feature_cols_dev: list of (n,) views
     (bins_dev[:, f]) to avoid re-slicing; assign_dev starts all-zero.

@@ -22,6 +22,17 @@ import jax  # noqa: E402
 # re-pin the platform list after import (before any backend initializes).
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the fused GBDT grower costs ~8s of XLA
+# compile per (num_leaves, F, B) config; caching across test runs keeps the
+# suite fast after the first run. Repo-local, gitignored.
+_cache_dir = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".jax_compile_cache",
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
