@@ -21,6 +21,21 @@ class Objective:
     kind = "base"
     num_model_per_iter = 1
 
+    def _static_key(self):
+        """Value identity for the jit cache: objectives are passed as static
+        args to compute.boost_loop_fused, and two objectives with equal
+        params must hit the same compiled executable (one compile per
+        config, not per fit). All subclass attrs are scalars/bools."""
+        return (type(self).__name__,
+                tuple(sorted(vars(self).items())))
+
+    def __hash__(self):
+        return hash(self._static_key())
+
+    def __eq__(self, other):
+        return (type(other) is type(self)
+                and other._static_key() == self._static_key())
+
     def init_score(self, y: np.ndarray, w: Optional[np.ndarray]) -> np.ndarray:
         return np.zeros(1, np.float32)
 

@@ -39,6 +39,10 @@ from mmlspark_tpu.gbdt.tree import (
 # host, so device-count-invariance (identical trees) can be asserted.
 _FORCE_SINGLE_DEVICE = False
 
+# Test hook: force the legacy per-iteration loop so fused-vs-legacy tree
+# identity can be asserted (tests/test_gbdt.py fused parity).
+_FORCE_LEGACY_LOOP = False
+
 
 class _DeferredTree:
     """A grown tree still living on device as grow_tree_fused's packed
@@ -284,6 +288,94 @@ def train_booster(
             max_depth=packed["max_depth"],
         )
         return outs[:, 0]
+
+    # -- FAST PATH: whole boosting loop in ONE device program ----------------
+    # gbdt/rf without valid-set eval, dart or goss ride compute.
+    # boost_loop_fused: a lax.scan over all iterations (gradients + fused
+    # grower + raw update), so the fit costs ~1 dispatch instead of ~3 per
+    # iteration — each dispatch/sync through a remote-chip tunnel can cost
+    # ~100 ms, which at 100 iterations was the whole 30 s fit (BASELINE.md).
+    # Bagging/feature-fraction draws replicate the legacy loop's host rng
+    # sequence exactly, so trees are identical to the per-iteration path.
+    fast_path = (
+        not dart_mode and not goss_mode and not has_valid
+        and cfg.num_iterations > 0
+        and not _FORCE_LEGACY_LOOP
+    )
+    if fast_path:
+        from mmlspark_tpu.gbdt.compute import boost_loop_fused
+
+        mask_bank = [train_rows]
+        mask_idx: List[int] = []
+        fmask_rows: List[np.ndarray] = []
+        cur = 0
+        for it in range(start_iter, start_iter + cfg.num_iterations):
+            if use_bagging and (rf_mode or it % max(1, cfg.bagging_freq) == 0):
+                frac = (
+                    cfg.bagging_fraction if cfg.bagging_fraction < 1.0 else 0.632
+                )
+                mask_bank.append(train_rows & (rng.random(n) < frac))
+                cur = len(mask_bank) - 1
+            mask_idx.append(cur if use_bagging else 0)
+            if cfg.feature_fraction < 1.0:
+                n_keep = max(1, int(np.ceil(cfg.feature_fraction * f)))
+                keep = frng.choice(f, size=n_keep, replace=False)
+                fm = np.zeros(f, bool)
+                fm[keep] = True
+            else:
+                fm = np.ones(f, bool)
+            fmask_rows.append(fm)
+
+        if nd > 1:
+            from mmlspark_tpu.parallel.mesh import batch_sharding
+
+            bank_dev = jax.device_put(
+                np.stack(mask_bank), batch_sharding(mesh, 2, axis=1)
+            )
+        else:
+            bank_dev = jax.device_put(np.stack(mask_bank))
+        w_arg = w_dev if w_dev is not None else y_dev
+        packs_dev, raw = boost_loop_fused(
+            bins_dev, y_dev, w_arg, raw,
+            bank_dev,
+            jnp.asarray(np.asarray(mask_idx, np.int32)),
+            jnp.asarray(np.stack(fmask_rows)),
+            n_bins_dev, cat_dev,
+            np.float32(cfg.min_data_in_leaf),
+            np.float32(cfg.min_sum_hessian_in_leaf),
+            np.float32(cfg.lambda_l1),
+            np.float32(cfg.lambda_l2),
+            np.float32(cfg.min_gain_to_split),
+            np.float32(lr),
+            objective=objective,
+            num_bins=num_bins_static,
+            num_leaves=cfg.num_leaves,
+            depth_limit=(
+                int(cfg.max_depth) if cfg.max_depth > 0 else cfg.num_leaves
+            ),
+            max_cat_threshold=int(grow_cfg.max_cat_threshold),
+            num_class=k,
+            rf=rf_mode,
+            has_w=w_dev is not None,
+        )
+        packs = np.asarray(packs_dev)  # ONE D2H for the whole fit
+        if k > 1:
+            packs = packs.reshape(cfg.num_iterations * k, -1)
+        for row in packs:
+            trees.append(
+                unpack_tree(row, cfg.num_leaves, num_bins_static,
+                            binner.threshold_value, grow_cfg)
+            )
+        return Booster(
+            trees,
+            objective.kind,
+            num_class=getattr(objective, "num_class", 1),
+            init_score=np.atleast_1d(init_score),
+            feature_names=feature_names,
+            num_features=f,
+            avg_output=rf_mode,
+            objective_params=_objective_params(objective),
+        )
 
     for it in range(start_iter, start_iter + cfg.num_iterations):
         # -- sampling -----------------------------------------------------------

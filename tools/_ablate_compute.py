@@ -22,9 +22,25 @@ def leaf_histogram(bins, grad, hess, mask, *, num_bins: int):
     """Histogram of (grad, hess, count) per (feature, bin) over masked rows.
 
     bins: (n, F) int32 in [0, num_bins); grad/hess: (n,) f32; mask: (n,) bool.
-    -> (F, num_bins, 3) float32. Single-dispatch wrapper over _hist_masked.
+    -> (F, num_bins, 3) float32.
     """
-    return _hist_masked(bins, grad, hess, mask, num_bins)
+    import jax.numpy as jnp
+
+    n, f = bins.shape
+    g = jnp.where(mask, grad, 0.0).astype(jnp.float32)
+    h = jnp.where(mask, hess, 0.0).astype(jnp.float32)
+    c = mask.astype(jnp.float32)
+    # flat scatter index per (row, feature): feature*B + bin
+    idx = bins + jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins
+    updates = jnp.stack(
+        [jnp.broadcast_to(g[:, None], (n, f)),
+         jnp.broadcast_to(h[:, None], (n, f)),
+         jnp.broadcast_to(c[:, None], (n, f))],
+        axis=-1,
+    )
+    flat = jnp.zeros((f * num_bins, 3), jnp.float32)
+    flat = flat.at[idx.reshape(-1)].add(updates.reshape(-1, 3))
+    return flat.reshape(f, num_bins, 3)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -49,29 +65,45 @@ def add_leaf_outputs(raw, assign, leaf_values):
 
 
 def _hist_masked(bins, grad, hess, mask, num_bins: int):
-    """(F, B, 3) histogram over masked rows — leaf_histogram's body, usable
-    inside a larger jit program.
-
-    Implemented as a one-hot einsum, not a scatter-add: TPU scatters with
-    colliding indices serialize (~4.6 ms per call on the Adult shape,
-    BASELINE.md round-4 ablation) while the MXU eats the one-hot contraction
-    at ~0.2 ms. The one-hot is bf16 (0/1 — exact); grad/hess are rounded to
-    bf16 but accumulate in f32 (preferred_element_type), and counts stay
-    exact because the count operand is also exact 0/1. The ~0.4% relative
-    rounding on individual g/h entries is far below split-decision noise.
-    """
+    """(F, B, 3) histogram over masked rows."""
     import jax.numpy as jnp
 
-    g = jnp.where(mask, grad, 0.0).astype(jnp.bfloat16)
-    h = jnp.where(mask, hess, 0.0).astype(jnp.bfloat16)
-    c = mask.astype(jnp.bfloat16)
-    vals = jnp.stack([g, h, c], axis=1)  # (n, 3)
-    oh = (bins[:, :, None] == jnp.arange(num_bins, dtype=jnp.int32)).astype(
-        jnp.bfloat16
+    n, f = bins.shape
+    g = jnp.where(mask, grad, 0.0).astype(jnp.float32)
+    h = jnp.where(mask, hess, 0.0).astype(jnp.float32)
+    c = mask.astype(jnp.float32)
+    if HIST_MODE == "gather":
+        vals = jnp.stack([g, h, c], axis=1)            # (n, 3)
+        sv = vals[_PERM]                               # (F, n, 3) gather
+        cs = jnp.cumsum(sv, axis=1)
+        cs = jnp.concatenate([jnp.zeros((f, 1, 3), jnp.float32), cs], axis=1)
+        bb = jnp.broadcast_to(_BOUND[:, :, None], (f, num_bins + 1, 3))
+        at = jnp.take_along_axis(cs, bb, axis=1)       # (F, B+1, 3)
+        return at[:, 1:] - at[:, :-1]
+    if HIST_MODE == "einsum_bf16":
+        vals = jnp.stack([g, h, c], axis=1).astype(jnp.bfloat16)
+        oh = (bins[:, :, None] == jnp.arange(num_bins, dtype=jnp.int32)).astype(jnp.bfloat16)
+        return jnp.einsum("nfb,nv->fbv", oh, vals, preferred_element_type=jnp.float32)
+    idx = bins + jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins
+    updates = jnp.stack(
+        [jnp.broadcast_to(g[:, None], (n, f)),
+         jnp.broadcast_to(h[:, None], (n, f)),
+         jnp.broadcast_to(c[:, None], (n, f))],
+        axis=-1,
     )
-    return jnp.einsum(
-        "nfb,nv->fbv", oh, vals, preferred_element_type=jnp.float32
-    )
+    flat = jnp.zeros((f * num_bins, 3), jnp.float32)
+    flat = flat.at[idx.reshape(-1)].add(updates.reshape(-1, 3))
+    return flat.reshape(f, num_bins, 3)
+
+
+ABL_CAT = True
+ABL_ROUTE = True
+ABL_ROOT = True
+HIST_MODE = "scatter"   # scatter | gather | einsum_bf16
+_PERM = None    # (F, n) int32 rows sorted by bin, per feature
+_BOUND = None   # (F, B+1) int32 segment boundaries
+ABL_HIST = True
+ABL_CHILD = True
 
 
 def _grow_tree_body(
@@ -163,38 +195,27 @@ def _grow_tree_body(
         nbest_t = jnp.argmax(ngain, axis=1)                 # (F,) first max
         nbest_gain = jnp.take_along_axis(ngain, nbest_t[:, None], 1)[:, 0]
 
-        # -- categorical: prefix cuts in g/h-ratio order, both directions ---
-        # Argsort-free: the cut "after element i of the stable sort" is the
-        # set {j : key_j < key_i or (key_j == key_i and j <= i)}. Building
-        # that as a (B, B) comparison matrix and taking prefix stats with a
-        # small einsum keeps the work on the MXU — the former double
-        # argsort + gather chain cost ~1 ms per best_split on TPU
-        # (BASELINE.md round-4 ablation). Cut SETS are identical to the
-        # sorted-prefix formulation; only the tie-break among equal-gain
-        # cuts differs (first original bin vs first sorted position).
+        # -- categorical: sorted by g/h ratio, both directions --------------
         bpos = jnp.arange(B)
         present = (c > 0) & (bpos[None, :] >= 1) & (bpos[None, :] < n_bins_arr[:, None])
         ratio = g / (h + l2 + 1e-12)
         kcats = present.sum(1)                              # (F,)
         lim = jnp.minimum(kcats - 1, max_cat_threshold)
-        stats3 = jnp.stack([g, h, c], axis=-1)              # (F, B, 3)
 
         def one_dir(key):
-            tie = (key[:, None, :] == key[:, :, None]) & (
-                bpos[None, None, :] <= bpos[None, :, None]
-            )
-            le = (key[:, None, :] < key[:, :, None]) | tie   # (F, B, B)
-            pref = jnp.einsum(
-                "fij,fjv->fiv", le.astype(jnp.float32), stats3,
-                preferred_element_type=jnp.float32,
-            )                                                # (F, B, 3)
-            cgl, chl, ccl = pref[..., 0], pref[..., 1], pref[..., 2]
+            order = jnp.argsort(key, axis=1)                # (F, B) stable
+            g_s = jnp.take_along_axis(g, order, 1)
+            h_s = jnp.take_along_axis(h, order, 1)
+            c_s = jnp.take_along_axis(c, order, 1)
+            cgl = jnp.cumsum(g_s, 1)
+            chl = jnp.cumsum(h_s, 1)
+            ccl = jnp.cumsum(c_s, 1)
             cgr = tg[:, None] - cgl
             chr_ = th[:, None] - chl
             ccr = tc[:, None] - ccl
-            pos = le.sum(-1) - 1                             # sorted position
+            jpos = jnp.arange(B)[None, :]
             cvalid = (
-                (pos < lim[:, None])
+                (jpos < lim[:, None])
                 & (ccl >= min_data) & (ccr >= min_data)
                 & (chl >= min_hess) & (chr_ >= min_hess)
                 & categorical_arr[:, None]
@@ -203,16 +224,22 @@ def _grow_tree_body(
             cgain = jnp.where(
                 cvalid, score(cgl, chl) + score(cgr, chr_) - parent[:, None], NEG
             )
-            ibest = jnp.argmax(cgain, axis=1)                # original bin id
-            return le, ibest, jnp.take_along_axis(cgain, ibest[:, None], 1)[:, 0], pref
+            jbest = jnp.argmax(cgain, axis=1)
+            return order, jbest, jnp.take_along_axis(cgain, jbest[:, None], 1)[:, 0]
 
         inf = jnp.float32(jnp.inf)
         key_asc = jnp.where(present, ratio, inf)
         key_desc = jnp.where(present, -ratio, inf)
-        le1, i1, g1, p1 = one_dir(key_asc)
-        le2, i2, g2, p2 = one_dir(key_desc)
+        if ABL_CAT:
+            o1, j1, g1 = one_dir(key_asc)
+            o2, j2, g2 = one_dir(key_desc)
+        else:
+            o1 = jnp.broadcast_to(jnp.arange(B)[None, :], (F, B))
+            j1 = jnp.zeros(F, jnp.int32); g1 = jnp.full(F, NEG)
+            o2, j2, g2 = o1, j1, g1
         use2 = g2 > g1                                      # strict, host parity
-        ci = jnp.where(use2, i2, i1)
+        corder = jnp.where(use2[:, None], o2, o1)
+        cj = jnp.where(use2, j2, j1)
         cbest_gain = jnp.maximum(g1, g2)
 
         # -- combine per feature, then first-argmax over features -----------
@@ -224,12 +251,18 @@ def _grow_tree_body(
         t_star = nbest_t[f_star]
         # member mask, True = left
         num_member = jnp.arange(B) <= t_star
-        cif = ci[f_star]
-        cat_member = jnp.where(use2[f_star], le2[f_star, cif], le1[f_star, cif])
+        ranks = jnp.zeros(B, jnp.int32).at[corder[f_star]].set(jnp.arange(B, dtype=jnp.int32))
+        cat_member = ranks <= cj[f_star]
         member = jnp.where(is_cat, cat_member, num_member)
         # left stats at the chosen cut
-        left_num = jnp.stack([cg[f_star, t_star], ch[f_star, t_star], cc[f_star, t_star]])
-        left_cat = jnp.where(use2[f_star], p2[f_star, cif], p1[f_star, cif])
+        def stats_at(cum_gl, cum_hl, cum_cl, idx):
+            return jnp.stack([cum_gl[f_star, idx], cum_hl[f_star, idx], cum_cl[f_star, idx]])
+
+        g_s = jnp.take_along_axis(g, corder, 1)
+        h_s = jnp.take_along_axis(h, corder, 1)
+        c_s = jnp.take_along_axis(c, corder, 1)
+        left_num = stats_at(cg, ch, cc, t_star)
+        left_cat = stats_at(jnp.cumsum(g_s, 1), jnp.cumsum(h_s, 1), jnp.cumsum(c_s, 1), cj[f_star])
         left = jnp.where(is_cat, left_cat, left_num)
         total = jnp.stack([tg[f_star], th[f_star], tc[f_star]])
         right = total - left
@@ -237,10 +270,18 @@ def _grow_tree_body(
         return gain, f_star.astype(jnp.int32), thr_bin, is_cat, member, left, right
 
     # -- root ----------------------------------------------------------------
-    hist0 = _hist_masked(bins, grad, hess, sample_mask, B)
-    root_stats = jnp.stack([hist0[0, :, 0].sum(), hist0[0, :, 1].sum(), hist0[0, :, 2].sum()])
-    depth_ok0 = jnp.asarray(0 < depth_limit)
-    bg0, bf0, bt0, bic0, bm0, bl0, br0 = best_split(hist0, depth_ok0)
+    if ABL_ROOT:
+        hist0 = _hist_masked(bins, grad, hess, sample_mask, B)
+        root_stats = jnp.stack([hist0[0, :, 0].sum(), hist0[0, :, 1].sum(), hist0[0, :, 2].sum()])
+        depth_ok0 = jnp.asarray(0 < depth_limit)
+        bg0, bf0, bt0, bic0, bm0, bl0, br0 = best_split(hist0, depth_ok0)
+    else:
+        hist0 = jnp.zeros((F, B, 3), jnp.float32) + grad[0] * 1e-20
+        root_stats = jnp.stack([hist0[0, :, 0].sum(), hist0[0, :, 1].sum(), hist0[0, :, 2].sum()])
+        depth_ok0 = jnp.asarray(0 < depth_limit)
+        bg0 = jnp.float32(1.0); bf0 = jnp.int32(0); bt0 = jnp.int32(1)
+        bic0 = jnp.asarray(False); bm0 = jnp.zeros(B, bool).at[0].set(True)
+        bl0 = jnp.full(3, 60.0); br0 = jnp.full(3, 60.0)
 
     state = dict(
         assign=jnp.zeros(bins.shape[0], jnp.int32),
@@ -314,21 +355,27 @@ def _grow_tree_body(
         )
 
         # route rows: member True = stay left (slot s), else new_slot
-        fcol = jnp.take(bins, st["best_feat"][s], axis=1)
-        go_left = st["best_member"][s][fcol]
-        st["assign"] = sel(
-            jnp.where((st["assign"] == s) & ~go_left, new_slot, st["assign"]).astype(jnp.int32),
-            st["assign"],
-        )
+        if ABL_ROUTE:
+            fcol = jnp.take(bins, st["best_feat"][s], axis=1)
+            go_left = st["best_member"][s][fcol]
+            st["assign"] = sel(
+                jnp.where((st["assign"] == s) & ~go_left, new_slot, st["assign"]).astype(jnp.int32),
+                st["assign"],
+            )
+        else:
+            st["assign"] = sel((st["assign"] + new_slot * 0).astype(jnp.int32), st["assign"])
 
         # child histograms: scatter the SMALLER child, subtract for sibling
         lcnt = st["best_left"][s, 2]
         rcnt = st["best_right"][s, 2]
         small_is_left = lcnt <= rcnt
         small_slot = jnp.where(small_is_left, s, new_slot)
-        small_hist = _hist_masked(
-            bins, grad, hess, sample_mask & (st["assign"] == small_slot), B
-        )
+        if ABL_HIST:
+            small_hist = _hist_masked(
+                bins, grad, hess, sample_mask & (st["assign"] == small_slot), B
+            )
+        else:
+            small_hist = st["hists"][s] * 0.5
         big_hist = st["hists"][s] - small_hist
         left_hist = jnp.where(small_is_left, small_hist, big_hist)
         right_hist = jnp.where(small_is_left, big_hist, small_hist)
@@ -348,9 +395,17 @@ def _grow_tree_body(
         # recompute best splits for the two children (one vmapped instance
         # of best_split keeps the compiled program half the size)
         depth_ok = depth < depth_limit
-        cg_, cf_, ct_, cic_, cm_, cl_, cr_ = jax.vmap(
-            lambda hh: best_split(hh, depth_ok)
-        )(jnp.stack([left_hist, right_hist]))
+        if ABL_CHILD:
+            cg_, cf_, ct_, cic_, cm_, cl_, cr_ = jax.vmap(
+                lambda hh: best_split(hh, depth_ok)
+            )(jnp.stack([left_hist, right_hist]))
+        else:
+            z = left_hist[0, 0, 0] * 1e-20
+            cg_ = jnp.stack([z + 1.0, z + 1.0])
+            cf_ = jnp.zeros(2, jnp.int32); ct_ = jnp.ones(2, jnp.int32)
+            cic_ = jnp.zeros(2, bool)
+            cm_ = jnp.zeros((2, B), bool).at[:, 0].set(True)
+            cl_ = jnp.full((2, 3), 60.0); cr_ = jnp.full((2, 3), 60.0)
         st["best_gain"] = sel(st["best_gain"].at[s].set(cg_[0]).at[new_slot].set(cg_[1]), st["best_gain"])
         st["best_feat"] = sel(st["best_feat"].at[s].set(cf_[0]).at[new_slot].set(cf_[1]), st["best_feat"])
         st["best_bin"] = sel(st["best_bin"].at[s].set(ct_[0]).at[new_slot].set(ct_[1]), st["best_bin"])
@@ -404,168 +459,3 @@ def _grow_tree_body(
     return packed, leaf_values, state["assign"]
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "num_bins", "num_leaves", "depth_limit", "max_cat_threshold",
-    ),
-)
-def grow_tree_fused(*args, **kwargs):
-    """Single-dispatch wrapper over _grow_tree_body (legacy per-iteration
-    path: dart/goss/early-stopping, and standalone tree growth)."""
-    return _grow_tree_body(*args, **kwargs)
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "objective", "num_bins", "num_leaves", "depth_limit",
-        "max_cat_threshold", "num_class", "rf", "has_w",
-    ),
-)
-def boost_loop_fused(
-    bins,            # (n, F) int32
-    y,               # (n,) f32
-    w,               # (n,) f32 (ignored when has_w=False)
-    raw0,            # (n,) f32 or (n, k) f32
-    sample_masks,    # (M, n) bool — deduped bank of bagging masks
-    mask_idx,        # (K,) int32 — per-iteration index into the bank
-    fmasks,          # (K, F) bool — per-iteration feature_fraction masks
-    n_bins_arr,      # (F,) int32
-    categorical_arr, # (F,) bool
-    min_data, min_hess, l1, l2, min_gain, learning_rate,  # traced f32 scalars
-    *,
-    objective,       # static: hashable Objective (grad_hess traced inline)
-    num_bins: int,
-    num_leaves: int,
-    depth_limit: int,
-    max_cat_threshold: int,
-    num_class: int,
-    rf: bool,
-    has_w: bool,
-):
-    """The ENTIRE boosting loop in one XLA program: lax.scan over K
-    iterations of (gradients -> fused tree growth -> raw-score update).
-
-    This replaces ~3 dispatches x K iterations with ONE dispatch per fit —
-    on remote-attached chips each dispatch/sync can cost ~100 ms of tunnel
-    latency, which at K=100 was the entire 30 s fit budget (BASELINE.md
-    round-4 profile). It is also the hot loop the reference runs natively
-    inside LGBM_BoosterUpdateOneIter (TrainUtils.scala:90-98): one call,
-    all iterations, nothing leaves the device until the packed trees are
-    fetched at the end.
-
-    Returns (packs, raw): packs (K, P) f32 for num_class==1 else
-    (K, num_class, P) — each row decodes with tree.unpack_tree — and the
-    final raw scores.
-
-    rf mode: gradients are taken at raw0 for every tree (bagged fits to the
-    initial gradients, trainer semantics); raw still accumulates so the
-    caller can average. Multiclass grows num_class trees per step from the
-    per-class gradient columns, matching the trainer's class-minor order.
-    """
-    import jax.numpy as jnp
-
-    w_ = w if has_w else None
-    if rf:
-        g0, h0 = objective.grad_hess(raw0, y, w_)
-
-    grow_kwargs = dict(
-        num_bins=num_bins, num_leaves=num_leaves, depth_limit=depth_limit,
-        max_cat_threshold=max_cat_threshold,
-    )
-
-    def body(raw, xs):
-        mi, fmask = xs
-        smask = sample_masks[mi]
-        if rf:
-            g, h = g0, h0
-        else:
-            g, h = objective.grad_hess(raw, y, w_)
-        if num_class > 1:
-            packs = []
-            for c in range(num_class):
-                packed, lv, assign = _grow_tree_body(
-                    bins, g[:, c], h[:, c], smask, n_bins_arr,
-                    categorical_arr, fmask, min_data, min_hess, l1, l2,
-                    min_gain, learning_rate, **grow_kwargs,
-                )
-                raw = raw.at[:, c].add(lv[assign])
-                packs.append(packed)
-            return raw, jnp.stack(packs)
-        packed, lv, assign = _grow_tree_body(
-            bins, g, h, smask, n_bins_arr, categorical_arr, fmask,
-            min_data, min_hess, l1, l2, min_gain, learning_rate,
-            **grow_kwargs,
-        )
-        return raw + lv[assign], packed
-
-    raw, packs = jax.lax.scan(body, raw0, (mask_idx, fmasks))
-    return packs, raw
-
-
-@functools.partial(jax.jit, static_argnames=("max_depth",))
-def walk_trees_binned(bins, feats, members, lefts, rights, is_leaf, values,
-                      *, max_depth: int):
-    """Score rows through a stack of trees using BINNED features.
-
-    bins: (n, F) int32. Tree arrays are padded to (T, m):
-    feats (T,m) int32, members (T,m,B) bool (True=left), lefts/rights (T,m),
-    is_leaf (T,m) bool, values (T,m) f32. -> (n, T) leaf outputs.
-    """
-    import jax.numpy as jnp
-
-    def one_tree(feat, member, left, right, leaf, value):
-        node = jnp.zeros(bins.shape[0], jnp.int32)
-
-        def step(node, _):
-            f = feat[node]                      # (n,)
-            b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0]
-            go_left = member[node, b]
-            nxt = jnp.where(go_left, left[node], right[node])
-            node = jnp.where(leaf[node], node, nxt)
-            return node, None
-
-        node, _ = jax.lax.scan(step, node, None, length=max_depth)
-        return value[node]
-
-    outs = jax.vmap(one_tree)(feats, members, lefts, rights, is_leaf, values)
-    return outs.T  # (n, T)
-
-
-@functools.partial(jax.jit, static_argnames=("max_depth",))
-def walk_trees_raw(x, feats, thresholds, is_cat, cat_masks, lefts, rights,
-                   is_leaf, values, *, max_depth: int):
-    """Score rows through trees from RAW float features (no binner needed —
-    the standalone-model path, like LGBM_BoosterPredictForMat).
-
-    x: (n, F) f32 (NaN allowed). thresholds (T,m) f32; is_cat (T,m) bool;
-    cat_masks (T,m,C) bool over integer category values. -> (n, T).
-    """
-    import jax.numpy as jnp
-
-    n = x.shape[0]
-    cat_size = cat_masks.shape[-1]
-
-    def one_tree(feat, thr, cat, cmask, left, right, leaf, value):
-        node = jnp.zeros(n, jnp.int32)
-
-        def step(node, _):
-            f = feat[node]
-            v = jnp.take_along_axis(x, f[:, None], axis=1)[:, 0]
-            nan = jnp.isnan(v)
-            num_left = nan | (v <= thr[node])
-            vi = jnp.clip(jnp.where(nan, -1, v).astype(jnp.int32), 0, cat_size - 1)
-            cat_left = cmask[node, vi] & ~nan
-            go_left = jnp.where(cat[node], cat_left, num_left)
-            nxt = jnp.where(go_left, left[node], right[node])
-            node = jnp.where(leaf[node], node, nxt)
-            return node, None
-
-        node, _ = jax.lax.scan(step, node, None, length=max_depth)
-        return value[node]
-
-    outs = jax.vmap(one_tree)(
-        feats, thresholds, is_cat, cat_masks, lefts, rights, is_leaf, values
-    )
-    return outs.T

@@ -1,0 +1,121 @@
+"""Per-phase GBDT fit profiler (VERDICT r3 weak#1: nobody has profiled it).
+
+Separates: (a) dispatch round-trip latency through the chip tunnel,
+(b) histogram kernel cost (scatter-add vs one-hot matmul), (c) the fused
+grower's single-tree cost, (d) end-to-end fit. Results go in BASELINE.md.
+
+Run: python tools/profile_gbdt.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, n=5, warmup=1):
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    print("devices:", jax.devices())
+
+    # (a) dispatch round-trip: trivial jit op, blocking each call
+    tiny = jnp.zeros(8, jnp.float32)
+    f_triv = jax.jit(lambda a: a + 1)
+    rt = timeit(lambda: f_triv(tiny), n=10)
+    print(f"dispatch_roundtrip_ms: {rt*1e3:.2f}")
+
+    # Adult-shape data
+    from bench import make_adult_like
+    from mmlspark_tpu.gbdt.binning import BinMapper
+
+    x, y, cat_idx = make_adult_like()
+    n0 = int(len(y) * 0.8)
+    x, y = x[:n0], y[:n0]
+    binner = BinMapper(255, cat_idx)
+    binner.fit(x)
+    bins = binner.transform(x)
+    pad = (-len(y)) % 1024
+    bins = np.concatenate([bins, np.zeros((pad, bins.shape[1]), bins.dtype)])
+    n, f = bins.shape
+    B = 256
+    print(f"n={n} f={f} B={B} per-feature bins={list(binner.n_bins)}")
+
+    bins_dev = jax.device_put(bins.astype(np.int32))
+    g = jax.device_put(np.random.default_rng(0).normal(size=n).astype(np.float32))
+    h = jax.device_put(np.abs(np.random.default_rng(1).normal(size=n)).astype(np.float32) + 0.1)
+    mask = jax.device_put(np.arange(n) < n0)
+
+    # (b) histogram kernels
+    from mmlspark_tpu.gbdt.compute import leaf_histogram
+
+    t_scatter = timeit(lambda: leaf_histogram(bins_dev, g, h, mask, num_bins=B))
+    print(f"hist_scatter_ms: {t_scatter*1e3:.2f}")
+
+    @jax.jit
+    def hist_matmul(bins, grad, hess, mask):
+        gm = jnp.where(mask, grad, 0.0).astype(jnp.float32)
+        hm = jnp.where(mask, hess, 0.0).astype(jnp.float32)
+        cm = mask.astype(jnp.float32)
+        vals = jnp.stack([gm, hm, cm], axis=1)  # (n, 3)
+
+        def chunk(carry, se):
+            b_c, v_c = se  # (C, F) int32, (C, 3)
+            oh = (b_c[:, :, None] == jnp.arange(B, dtype=jnp.int32)).astype(jnp.float32)
+            hist = jnp.einsum("cfb,cv->fbv", oh, v_c,
+                              preferred_element_type=jnp.float32)
+            return carry + hist, None
+
+        C = 1024
+        nb = bins.shape[0] // C
+        out, _ = jax.lax.scan(
+            chunk,
+            jnp.zeros((f, B, 3), jnp.float32),
+            (bins.reshape(nb, C, f), vals.reshape(nb, C, 3)),
+        )
+        return out
+
+    t_mm = timeit(lambda: hist_matmul(bins_dev, g, h, mask))
+    print(f"hist_matmul_ms: {t_mm*1e3:.2f}")
+    a = np.asarray(leaf_histogram(bins_dev, g, h, mask, num_bins=B))
+    b = np.asarray(hist_matmul(bins_dev, g, h, mask))
+    print("hist parity max abs diff:", float(np.abs(a - b).max()))
+
+    # (c) fused grower, one tree
+    from mmlspark_tpu.gbdt.tree import GrowConfig, grow_tree_packed
+
+    cfg = GrowConfig(num_leaves=31, max_depth=-1, min_data_in_leaf=20,
+                     min_sum_hessian_in_leaf=1e-3, lambda_l1=0.0, lambda_l2=0.0,
+                     min_gain_to_split=0.0, learning_rate=0.1)
+    n_bins_dev = jnp.asarray(np.asarray(binner.n_bins, np.int32))
+    cat_dev = jnp.asarray(np.asarray([binner.is_categorical(j) for j in range(f)], bool))
+    fmask = jnp.asarray(np.ones(f, bool))
+
+    def one_tree():
+        p, lv, a = grow_tree_packed(bins_dev, g, h, mask, n_bins_dev, cat_dev,
+                                    fmask, B, cfg)
+        return p
+
+    t_tree = timeit(one_tree, n=5)
+    print(f"grow_tree_ms: {t_tree*1e3:.2f}  (x100 trees = {t_tree*100:.2f}s)")
+
+    # (d) end-to-end fit (warm cache)
+    from bench import bench_gbdt
+    secs, auc = bench_gbdt()
+    print(f"fit_seconds: {secs:.2f} auc: {auc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

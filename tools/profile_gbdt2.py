@@ -1,0 +1,117 @@
+"""Micro-profile of grow_tree_fused loop-body components."""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, n=5, warmup=1):
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+F, B, L, N = 14, 256, 31, 39936
+rng = np.random.default_rng(0)
+hist = jax.device_put(rng.normal(size=(F, B, 3)).astype(np.float32))
+key = jax.device_put(rng.normal(size=(F, B)).astype(np.float32))
+bins = jax.device_put(rng.integers(0, B, size=(N, F)).astype(np.int32))
+g = jax.device_put(rng.normal(size=N).astype(np.float32))
+h = jax.device_put(np.abs(rng.normal(size=N)).astype(np.float32))
+mask = jax.device_put(np.ones(N, bool))
+
+# 1. argsort (F, B)
+f_sort = jax.jit(lambda k: jnp.argsort(k, axis=1))
+print(f"argsort_FB_ms: {timeit(lambda: f_sort(key))*1e3:.3f}")
+
+# 2. two argsorts + take_along_axis + cumsums (the one_dir body)
+@jax.jit
+def one_dir(k, hh):
+    order = jnp.argsort(k, axis=1)
+    g_s = jnp.take_along_axis(hh[..., 0], order, 1)
+    h_s = jnp.take_along_axis(hh[..., 1], order, 1)
+    c_s = jnp.take_along_axis(hh[..., 2], order, 1)
+    return jnp.cumsum(g_s, 1) + jnp.cumsum(h_s, 1) + jnp.cumsum(c_s, 1)
+
+print(f"one_dir_ms: {timeit(lambda: one_dir(key, hist))*1e3:.3f}")
+
+# 3. comparison-matrix prefix (argsort-free categorical scan)
+@jax.jit
+def cmp_prefix(k, hh):
+    idx = jnp.arange(B)
+    le = (k[:, None, :] < k[:, :, None]) | (
+        (k[:, None, :] == k[:, :, None]) & (idx[None, None, :] <= idx[None, :, None])
+    )
+    return jnp.einsum("fij,fjv->fiv", le.astype(jnp.float32), hh,
+                      preferred_element_type=jnp.float32)
+
+print(f"cmp_prefix_ms: {timeit(lambda: cmp_prefix(key, hist))*1e3:.3f}")
+
+# 4. full-data masked histogram (as inside loop body)
+from mmlspark_tpu.gbdt.compute import _hist_masked
+
+f_hist = jax.jit(lambda m: _hist_masked(bins, g, h, m, B))
+print(f"hist_masked_ms: {timeit(lambda: f_hist(mask))*1e3:.3f}")
+
+# 5. assign-update gather+where over n rows
+@jax.jit
+def route(assign, member, fcol):
+    go_left = member[fcol]
+    return jnp.where((assign == 3) & ~go_left, 7, assign).astype(jnp.int32)
+
+assign = jax.device_put(np.zeros(N, np.int32))
+member = jax.device_put(np.ones(B, bool))
+fcol = jax.device_put(rng.integers(0, B, N).astype(np.int32))
+print(f"route_ms: {timeit(lambda: route(assign, member, fcol))*1e3:.3f}")
+
+# 6. while_loop of 30 trivial steps over the big state (state-copy overhead)
+def mk_state():
+    return dict(
+        assign=jnp.zeros(N, jnp.int32),
+        hists=jnp.zeros((L, F, B, 3), jnp.float32),
+        best_member=jnp.zeros((L, B), bool),
+        node_member=jnp.zeros((L, B), bool),
+        step=jnp.int32(0),
+    )
+
+@jax.jit
+def wl_trivial(st):
+    def body(s):
+        s["hists"] = s["hists"].at[0].set(s["hists"][1] + 1.0)
+        s["step"] = s["step"] + 1
+        return s
+    return jax.lax.while_loop(lambda s: s["step"] < 30, body, st)["step"]
+
+print(f"whileloop30_trivial_ms: {timeit(lambda: wl_trivial(mk_state()))*1e3:.3f}")
+
+# 7. while_loop of 30 steps doing hist + 2x(2x one_dir) (approx real body)
+@jax.jit
+def wl_real(st):
+    def body(s):
+        m = mask & (s["assign"] == 0)
+        hh = _hist_masked(bins, g, h, m, B)
+        acc = 0.0
+        for _ in range(2):      # two children
+            for sgn in (1.0, -1.0):  # two directions
+                acc = acc + one_dir_body(sgn * key, hh)
+        s["hists"] = s["hists"].at[0].set(hh + acc * 0.0)
+        s["step"] = s["step"] + 1
+        return s
+    return jax.lax.while_loop(lambda s: s["step"] < 30, body, st)["step"]
+
+def one_dir_body(k, hh):
+    order = jnp.argsort(k, axis=1)
+    g_s = jnp.take_along_axis(hh[..., 0], order, 1)
+    return jnp.cumsum(g_s, 1)[:, :, None] * jnp.ones((1, 1, 3))
+
+print(f"whileloop30_hist+4argsort_ms: {timeit(lambda: wl_real(mk_state()))*1e3:.3f}")

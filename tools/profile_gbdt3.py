@@ -1,0 +1,52 @@
+"""Bisect why large-row ops sometimes cost ~113ms."""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, n=5, warmup=2, label=""):
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    print(f"{label}: median {np.median(ts)*1e3:.3f} ms  all={[f'{t*1e3:.2f}' for t in ts]}")
+
+
+N = 39936
+rng = np.random.default_rng(0)
+a_np = rng.normal(size=N).astype(np.float32)
+a = jax.device_put(a_np)
+
+f_add = jax.jit(lambda x: x + 1.0)
+timeit(lambda: f_add(a), label="add1_39936")
+
+b = jax.device_put(rng.normal(size=1024).astype(np.float32))
+timeit(lambda: f_add(b), label="add1_1024")
+
+c = jax.device_put(rng.normal(size=(39936, 14)).astype(np.float32))
+f_sum = jax.jit(lambda x: x.sum())
+timeit(lambda: f_sum(c), label="sum_39936x14")
+
+# int32 gather like route
+fcol = jax.device_put(rng.integers(0, 256, N).astype(np.int32))
+member = jax.device_put(np.ones(256, bool))
+f_gather = jax.jit(lambda m, i: m[i])
+timeit(lambda: f_gather(member, fcol), label="gather_39936")
+
+# bool mask out
+mask = jax.device_put(np.ones(N, bool))
+f_where = jax.jit(lambda x, m: jnp.where(m, x, 0.0))
+timeit(lambda: f_where(a, mask), label="where_39936")
+
+# returning large vs small
+f_small = jax.jit(lambda x: (x + 1.0).sum())
+timeit(lambda: f_small(a), label="add_reduce_to_scalar")

@@ -1,0 +1,76 @@
+"""Scaling bisect of grow_tree_fused: vary L, B, n; sentinel op tracks
+tunnel mood so slow-RTT windows are visible in the numbers."""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu.gbdt.binning import BinMapper
+from mmlspark_tpu.gbdt.tree import GrowConfig, grow_tree_packed
+from bench import make_adult_like
+
+
+def timeit(fn, n=3, warmup=1):
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+sent = jax.device_put(jnp.zeros(8))
+f_sent = jax.jit(lambda a: a + 1)
+
+
+def sentinel():
+    return timeit(lambda: f_sent(sent), n=3) * 1e3
+
+
+x, y, cat_idx = make_adult_like()
+x = x[:39073]
+
+rng = np.random.default_rng(0)
+
+
+def run(max_bin, num_leaves, n_rows, cats=True):
+    xi = x[:n_rows]
+    binner = BinMapper(max_bin, cat_idx if cats else [])
+    binner.fit(xi)
+    rb = binner.transform(xi)
+    pad = (-len(rb)) % 1024
+    rb = np.concatenate([rb, np.zeros((pad, 14), rb.dtype)]).astype(np.int32)
+    n = len(rb)
+    B = binner.max_n_bins
+    bins_dev = jax.device_put(rb)
+    g = jax.device_put(rng.normal(size=n).astype(np.float32))
+    h = jax.device_put((np.abs(rng.normal(size=n)) + 0.1).astype(np.float32))
+    mask = jax.device_put(np.arange(n) < n_rows)
+    nb = jnp.asarray(np.asarray(binner.n_bins, np.int32))
+    cat = jnp.asarray(np.asarray([binner.is_categorical(j) for j in range(14)], bool))
+    fm = jnp.asarray(np.ones(14, bool))
+    cfg = GrowConfig(num_leaves=num_leaves, max_depth=-1, min_data_in_leaf=20,
+                     min_sum_hessian_in_leaf=1e-3, lambda_l1=0.0, lambda_l2=0.0,
+                     min_gain_to_split=0.0, learning_rate=0.1)
+
+    t = timeit(lambda: grow_tree_packed(bins_dev, g, h, mask, nb, cat, fm, B, cfg)[0])
+    print(f"B={B:<4} L={num_leaves:<3} n={n:<6} cats={cats}: "
+          f"{t*1e3:8.2f} ms   [sentinel {sentinel():.2f} ms]")
+
+
+print(f"[sentinel {sentinel():.2f} ms]")
+run(255, 31, 39073)          # baseline config
+run(255, 2, 39073)           # 1 split: fixed cost
+run(255, 8, 39073)
+run(255, 16, 39073)
+run(63, 31, 39073)           # smaller B
+run(255, 31, 8000)           # fewer rows
+run(255, 31, 2000)
+run(255, 31, 39073, cats=False)  # no categorical features

@@ -1,0 +1,218 @@
+"""Ablate grow-tree body components to find the 187ms/tree cost.
+
+All variants run a FIXED 30 steps (done-flag ignored) so timing compares
+structure, not convergence.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu.gbdt.binning import BinMapper
+from bench import make_adult_like
+
+x, y, cat_idx = make_adult_like()
+n0 = int(len(y) * 0.8)
+binner = BinMapper(255, cat_idx)
+binner.fit(x[:n0])
+rb = binner.transform(x[:n0])
+pad = (-len(rb)) % 1024
+rb = np.concatenate([rb, np.zeros((pad, 14), rb.dtype)]).astype(np.int32)
+n, F = rb.shape
+B = int(max(binner.n_bins))
+L = 31
+bins_h = rb
+bins = jax.device_put(rb)
+rng = np.random.default_rng(0)
+g0 = jax.device_put(rng.normal(size=n).astype(np.float32))
+h0 = jax.device_put((np.abs(rng.normal(size=n)) + 0.1).astype(np.float32))
+mask = jax.device_put(np.arange(n) < n0)
+n_bins_arr = jnp.asarray(np.asarray(binner.n_bins, np.int32))
+categorical_arr = jnp.asarray(np.asarray([binner.is_categorical(j) for j in range(14)], bool))
+feature_mask = jnp.asarray(np.ones(14, bool))
+min_data, min_hess, l1, l2 = jnp.float32(20), jnp.float32(1e-3), jnp.float32(0.0), jnp.float32(0.0)
+NEG = jnp.float32(-jnp.inf)
+
+
+def make_body(do_cat=True, do_hist=True, do_child=True, do_route=True):
+    def thresh(g):
+        return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
+
+    def score(g, h):
+        t = thresh(g)
+        return t * t / jnp.maximum(h + l2, 1e-35)
+
+    def hist_fn(grad, hess, m):
+        gg = jnp.where(m, grad, 0.0)
+        hh = jnp.where(m, hess, 0.0)
+        c = m.astype(jnp.float32)
+        idx = bins + jnp.arange(F, dtype=jnp.int32)[None, :] * B
+        upd = jnp.stack([jnp.broadcast_to(gg[:, None], (n, F)),
+                         jnp.broadcast_to(hh[:, None], (n, F)),
+                         jnp.broadcast_to(c[:, None], (n, F))], axis=-1)
+        flat = jnp.zeros((F * B, 3), jnp.float32).at[idx.reshape(-1)].add(upd.reshape(-1, 3))
+        return flat.reshape(F, B, 3)
+
+    def best_split(hist, depth_ok):
+        g, h, c = hist[..., 0], hist[..., 1], hist[..., 2]
+        tg, th, tc = g.sum(1), h.sum(1), c.sum(1)
+        parent = score(tg, th)
+        leaf_ok = (tc >= 2.0 * min_data) & feature_mask & depth_ok
+        cg, ch, cc = jnp.cumsum(g, 1), jnp.cumsum(h, 1), jnp.cumsum(c, 1)
+        tpos = jnp.arange(B)[None, :]
+        gl, hl, cl = cg, ch, cc
+        gr, hr, cr = tg[:, None] - gl, th[:, None] - hl, tc[:, None] - cl
+        nvalid = ((tpos >= 1) & (tpos <= n_bins_arr[:, None] - 2)
+                  & (cl >= min_data) & (cr >= min_data)
+                  & (hl >= min_hess) & (hr >= min_hess)
+                  & (~categorical_arr)[:, None] & leaf_ok[:, None])
+        ngain = jnp.where(nvalid, score(gl, hl) + score(gr, hr) - parent[:, None], NEG)
+        nbest_t = jnp.argmax(ngain, axis=1)
+        nbest_gain = jnp.take_along_axis(ngain, nbest_t[:, None], 1)[:, 0]
+
+        if do_cat:
+            bpos = jnp.arange(B)
+            present = (c > 0) & (bpos[None, :] >= 1) & (bpos[None, :] < n_bins_arr[:, None])
+            ratio = g / (h + l2 + 1e-12)
+            kcats = present.sum(1)
+            lim = jnp.minimum(kcats - 1, 32)
+
+            def one_dir(key):
+                order = jnp.argsort(key, axis=1)
+                g_s = jnp.take_along_axis(g, order, 1)
+                h_s = jnp.take_along_axis(h, order, 1)
+                c_s = jnp.take_along_axis(c, order, 1)
+                cgl = jnp.cumsum(g_s, 1)
+                chl = jnp.cumsum(h_s, 1)
+                ccl = jnp.cumsum(c_s, 1)
+                cgr = tg[:, None] - cgl
+                chr_ = th[:, None] - chl
+                ccr = tc[:, None] - ccl
+                jpos = jnp.arange(B)[None, :]
+                cvalid = ((jpos < lim[:, None]) & (ccl >= min_data) & (ccr >= min_data)
+                          & (chl >= min_hess) & (chr_ >= min_hess)
+                          & categorical_arr[:, None] & leaf_ok[:, None])
+                cgain = jnp.where(cvalid, score(cgl, chl) + score(cgr, chr_) - parent[:, None], NEG)
+                jbest = jnp.argmax(cgain, axis=1)
+                return order, jbest, jnp.take_along_axis(cgain, jbest[:, None], 1)[:, 0]
+
+            inf = jnp.float32(jnp.inf)
+            o1, j1, g1 = one_dir(jnp.where(present, ratio, inf))
+            o2, j2, g2 = one_dir(jnp.where(present, -ratio, inf))
+            use2 = g2 > g1
+            corder = jnp.where(use2[:, None], o2, o1)
+            cj = jnp.where(use2, j2, j1)
+            cbest_gain = jnp.maximum(g1, g2)
+        else:
+            corder = jnp.broadcast_to(jnp.arange(B)[None, :], (F, B))
+            cj = jnp.zeros(F, jnp.int32)
+            cbest_gain = jnp.full(F, NEG)
+
+        fgain = jnp.maximum(nbest_gain, cbest_gain)
+        use_cat_f = cbest_gain > nbest_gain
+        f_star = jnp.argmax(fgain)
+        gain = fgain[f_star]
+        is_cat = use_cat_f[f_star] & categorical_arr[f_star]
+        t_star = nbest_t[f_star]
+        num_member = jnp.arange(B) <= t_star
+        ranks = jnp.zeros(B, jnp.int32).at[corder[f_star]].set(jnp.arange(B, dtype=jnp.int32))
+        cat_member = ranks <= cj[f_star]
+        member = jnp.where(is_cat, cat_member, num_member)
+        g_s = jnp.take_along_axis(g, corder, 1)
+        h_s = jnp.take_along_axis(h, corder, 1)
+        c_s = jnp.take_along_axis(c, corder, 1)
+        left_num = jnp.stack([cg[f_star, t_star], ch[f_star, t_star], cc[f_star, t_star]])
+        cjf = cj[f_star]
+        left_cat = jnp.stack([jnp.cumsum(g_s, 1)[f_star, cjf], jnp.cumsum(h_s, 1)[f_star, cjf], jnp.cumsum(c_s, 1)[f_star, cjf]])
+        left = jnp.where(is_cat, left_cat, left_num)
+        total = jnp.stack([tg[f_star], th[f_star], tc[f_star]])
+        right = total - left
+        return gain, f_star.astype(jnp.int32), member, left, right
+
+    def grow(grad, hess):
+        hist0 = hist_fn(grad, hess, mask)
+        bg0, bf0, bm0, bl0, br0 = best_split(hist0, jnp.asarray(True))
+        state = dict(
+            assign=jnp.zeros(n, jnp.int32),
+            hists=jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist0),
+            best_gain=jnp.full(L, NEG).at[0].set(bg0),
+            best_feat=jnp.zeros(L, jnp.int32).at[0].set(bf0),
+            best_member=jnp.zeros((L, B), bool).at[0].set(bm0),
+            best_left=jnp.zeros((L, 3), jnp.float32).at[0].set(bl0),
+            best_right=jnp.zeros((L, 3), jnp.float32).at[0].set(br0),
+            n_leaves=jnp.int32(1),
+            step=jnp.int32(0),
+        )
+
+        def body(st):
+            s = jnp.argmax(st["best_gain"]).astype(jnp.int32)
+            new_slot = st["n_leaves"]
+            if do_route:
+                fcol = jnp.take(bins, st["best_feat"][s], axis=1)
+                go_left = st["best_member"][s][fcol]
+                st["assign"] = jnp.where((st["assign"] == s) & ~go_left, new_slot, st["assign"]).astype(jnp.int32)
+            if do_hist:
+                lcnt = st["best_left"][s, 2]
+                rcnt = st["best_right"][s, 2]
+                small_is_left = lcnt <= rcnt
+                small_slot = jnp.where(small_is_left, s, new_slot)
+                small_hist = hist_fn(grad, hess, mask & (st["assign"] == small_slot))
+                big_hist = st["hists"][s] - small_hist
+                left_hist = jnp.where(small_is_left, small_hist, big_hist)
+                right_hist = jnp.where(small_is_left, big_hist, small_hist)
+            else:
+                left_hist = st["hists"][s] * 0.5
+                right_hist = st["hists"][s] * 0.5
+            st["hists"] = st["hists"].at[s].set(left_hist).at[new_slot].set(right_hist)
+            if do_child:
+                cg_, cf_, cm_, cl_, cr_ = jax.vmap(lambda hh: best_split(hh, jnp.asarray(True)))(jnp.stack([left_hist, right_hist]))
+                st["best_gain"] = st["best_gain"].at[s].set(cg_[0]).at[new_slot].set(cg_[1])
+                st["best_feat"] = st["best_feat"].at[s].set(cf_[0]).at[new_slot].set(cf_[1])
+                st["best_member"] = st["best_member"].at[s].set(cm_[0]).at[new_slot].set(cm_[1])
+                st["best_left"] = st["best_left"].at[s].set(cl_[0]).at[new_slot].set(cl_[1])
+                st["best_right"] = st["best_right"].at[s].set(cr_[0]).at[new_slot].set(cr_[1])
+            else:
+                st["best_gain"] = st["best_gain"].at[s].set(left_hist[0, 0, 0] * 1e-20)
+            st["n_leaves"] = st["n_leaves"] + 1
+            st["step"] = st["step"] + 1
+            return st
+
+        state = jax.lax.while_loop(lambda st: st["step"] < L - 1, body, state)
+        return state["best_gain"]
+
+    return grow
+
+
+def time_variant(label, **kw):
+    grow = make_body(**kw)
+
+    @jax.jit
+    def prog(g, h):
+        def body(carry, _):
+            out = grow(g + carry * 1e-20, h)
+            return out[0], None
+        out, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=5)
+        return out
+
+    r = prog(g0, h0)
+    jax.block_until_ready(r)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(prog(g0, h0))
+        ts.append(time.perf_counter() - t0)
+    t = float(np.median(ts))
+    print(f"{label}: {t/5*1e3:8.2f} ms/tree")
+
+
+time_variant("full                    ")
+time_variant("no categorical argsorts ", do_cat=False)
+time_variant("no child best_split     ", do_child=False)
+time_variant("no hist (fake halves)   ", do_hist=False)
+time_variant("no row routing          ", do_route=False)
+time_variant("no hist no child        ", do_hist=False, do_child=False)
