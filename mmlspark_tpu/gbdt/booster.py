@@ -24,10 +24,9 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from mmlspark_tpu.gbdt.objectives import Objective, make_objective
-from mmlspark_tpu.gbdt.tree import Tree
+from mmlspark_tpu.gbdt.tree import Tree, _CAT_WIDTH_CAP
 
 _MAX_CAT_VALUES = 256
-_CAT_WIDTH_CAP = 4096  # dense (T, m, C) bool mask: bound device memory
 
 
 class Booster:

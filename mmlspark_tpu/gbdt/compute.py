@@ -556,7 +556,9 @@ def walk_trees_raw(x, feats, thresholds, is_cat, cat_masks, lefts, rights,
             nan = jnp.isnan(v)
             num_left = nan | (v <= thr[node])
             vi = jnp.clip(jnp.where(nan, -1, v).astype(jnp.int32), 0, cat_size - 1)
-            cat_left = cmask[node, vi] & ~nan
+            # negative categorical values are missing-like (upstream LightGBM
+            # semantics): route right, never alias category 0
+            cat_left = cmask[node, vi] & ~nan & (v >= 0)
             go_left = jnp.where(cat[node], cat_left, num_left)
             nxt = jnp.where(go_left, left[node], right[node])
             node = jnp.where(leaf[node], node, nxt)

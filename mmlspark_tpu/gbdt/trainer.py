@@ -131,9 +131,10 @@ def train_booster(
     #
     # Rows always pad up to a 1024 block (masked out of every histogram):
     # the fused grower compiles per row-count, so quantizing n means one
-    # compiled program serves every dataset in the block — and since
-    # nd | 1024 the padded size is device-count-invariant, keeping bagging
-    # draws identical across mesh sizes.
+    # compiled program serves every dataset in the block. Bagging randoms
+    # are drawn over the 1024-quantized size (not the mesh-dependent lcm
+    # pad), so draws — and hence trees — are identical across mesh sizes
+    # even when nd does not divide 1024.
     n_orig = n
     y_host = np.asarray(y, np.float64)
     import math
@@ -152,6 +153,7 @@ def train_booster(
         nd = 1
         shard = jax.device_put
 
+    n_base = n + ((-n) % 1024)  # device-count-invariant bagging draw length
     pad = (-n) % math.lcm(1024, nd)
     if pad:  # zero-weight pad rows, excluded from train_rows everywhere
         bins = np.concatenate([bins, np.zeros((pad, f), bins.dtype)])
@@ -239,6 +241,15 @@ def train_booster(
 
     rng = np.random.default_rng(cfg.bagging_seed)
     frng = np.random.default_rng(cfg.bagging_seed + 17)
+
+    def bag_draw() -> np.ndarray:
+        # (n,) uniform draw whose values on real rows don't depend on the
+        # mesh size: always consume n_base >= n_orig randoms (1024-quantized)
+        # and resize to the lcm-padded n; pad rows are train_rows-masked out.
+        r = rng.random(n_base)
+        if n_base >= n:
+            return r[:n]
+        return np.concatenate([r, np.ones(n - n_base)])  # pad rows never bag in
     trees: List[Any] = list(init_model.trees) if init_model is not None else []
     start_iter = len(trees) // k
     bag_mask = train_rows.copy()
@@ -314,7 +325,7 @@ def train_booster(
                 frac = (
                     cfg.bagging_fraction if cfg.bagging_fraction < 1.0 else 0.632
                 )
-                mask_bank.append(train_rows & (rng.random(n) < frac))
+                mask_bank.append(train_rows & (bag_draw() < frac))
                 cur = len(mask_bank) - 1
             mask_idx.append(cur if use_bagging else 0)
             if cfg.feature_fraction < 1.0:
@@ -381,7 +392,7 @@ def train_booster(
         # -- sampling -----------------------------------------------------------
         if use_bagging and (rf_mode or it % max(1, cfg.bagging_freq) == 0):
             frac = cfg.bagging_fraction if cfg.bagging_fraction < 1.0 else 0.632
-            bag_mask = train_rows & (rng.random(n) < frac)
+            bag_mask = train_rows & (bag_draw() < frac)
         sample_amp = None
 
         # rf: trees are independent (bagged fits to the INITIAL gradients),

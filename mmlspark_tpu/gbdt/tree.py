@@ -15,6 +15,11 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+# Dense (T, m, C) bool categorical mask width cap (bounds device memory);
+# the device walk and the host reference walk both route values >= cap-1
+# (and negatives) to the right child. booster.py imports this.
+_CAT_WIDTH_CAP = 4096
+
 
 @dataclasses.dataclass
 class GrowConfig:
@@ -196,7 +201,15 @@ class Tree:
             f = self.split_feature[node]
             v = x[f]
             if self.is_categorical[node]:
-                left = (not np.isnan(v)) and int(v) in self.cat_left[node]
+                # Mirror the device walk's dense-mask cap (_CAT_WIDTH_CAP):
+                # categories beyond the cap route right there, so the host
+                # reference must agree or host/device predictions diverge.
+                left = (
+                    (not np.isnan(v))
+                    and v >= 0  # float test: int(-0.5)==0 must NOT alias cat 0
+                    and int(v) < _CAT_WIDTH_CAP - 1
+                    and int(v) in self.cat_left[node]
+                )
             else:
                 # f32 comparison: thresholds are f32-representable bin edges
                 # and device scoring runs in f32 (binning.py fit)

@@ -91,6 +91,10 @@ class TPUModel(Model, Wrappable):
     featurization).
     """
 
+    # HBM budget for device-resident results before spilling to host
+    # (f32 elements; 64M = 256 MB)
+    _SPILL_ELEMS = 64 * 1024 * 1024
+
     model = ComplexParam("model", "The NetworkBundle (spec + variables) to evaluate")
     input_col = Param("input_col", "The name of the input column", TypeConverters.to_string)
     output_col = Param("output_col", "The name of the output column", TypeConverters.to_string)
@@ -231,9 +235,16 @@ class TPUModel(Model, Wrappable):
         # stay on device and are fetched ONCE at the end. Compute stays
         # async behind the uploads; a window bounds in-flight batches so
         # peak HBM stays O(window * batch), not O(dataset).
+        # Device-resident results are additionally capped: once accumulated
+        # output elements pass _SPILL_ELEMS (f32 x 64M = 256 MB HBM) the
+        # oldest batches spill to host, so peak HBM for results is bounded
+        # even for large out_dim — without giving up the fetch-once fast
+        # path for the common small-score-vector case.
         window = 4
         in_flight: list = []
         results = []  # (y_dev, real) kept on device
+        spilled: list = []  # np arrays already fetched (large-output case)
+        dev_elems = 0
         for start in range(0, n, bs):
             chunk = x[start : start + bs]
             padded, real = pad_to_multiple(chunk, bs, axis=0)
@@ -245,14 +256,25 @@ class TPUModel(Model, Wrappable):
             y = fn(variables, xd)
             in_flight.append(y)
             results.append((y, real))
+            dev_elems += int(np.prod(y.shape))
             if len(in_flight) > window:
                 in_flight.pop(0).block_until_ready()
-        if not results:
+            while dev_elems > self._SPILL_ELEMS and len(results) > 1:
+                y0, real0 = results.pop(0)
+                spilled.append(np.asarray(y0[:real0], dtype=np.float32))
+                dev_elems -= int(np.prod(y0.shape))
+                # the fetch above synced y0 — keeping it in the window would
+                # defeat the HBM bound the spill exists to enforce
+                in_flight = [w for w in in_flight if w is not y0]
+        if not results and not spilled:
             out_dim = net.out_shape()
             return np.zeros((0,) + tuple(out_dim), np.float32)
         trimmed = [y[:real] for y, real in results]
         full = trimmed[0] if len(trimmed) == 1 else jnp.concatenate(trimmed, axis=0)
-        return np.asarray(full, dtype=np.float32)
+        tail = np.asarray(full, dtype=np.float32)
+        if spilled:
+            return np.concatenate(spilled + [tail], axis=0)
+        return tail
 
     # -- stage contract --------------------------------------------------------
 

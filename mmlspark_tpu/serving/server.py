@@ -254,7 +254,9 @@ class ServingServer:
                     outer._score_now(exchange)
                 else:
                     with outer._queue_lock:
-                        outer._queue.append((str(uuid.uuid4()), exchange))
+                        outer._queue.append(
+                            (str(uuid.uuid4()), exchange, time.monotonic())
+                        )
                         outer._queue_lock.notify()
                 if not exchange.event.wait(outer.request_timeout):
                     self._send(_status(504, "Gateway Timeout"))
@@ -282,7 +284,7 @@ class ServingServer:
             pending = self._queue
             self._queue = []
             self._queue_lock.notify_all()
-        for _, ex in pending:
+        for _, ex, _t in pending:
             ex.respond(_status(503, "Service Unavailable"))
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -343,11 +345,16 @@ class ServingServer:
                     and not self._stopping.is_set()
                 ):
                     self._queue_lock.wait(max(0.0, deadline - time.monotonic()))
+                # Requests whose client already got a 504 are dead — scoring
+                # them would burn batch slots and model-lock time on replies
+                # nobody reads.
+                cutoff = time.monotonic() - self.request_timeout
+                self._queue = [e for e in self._queue if e[2] > cutoff]
                 batch = self._queue[: self.max_batch_size]
                 self._queue = self._queue[self.max_batch_size:]
             if batch:
-                ids = [rid for rid, _ in batch]
-                exchanges = [ex for _, ex in batch]
+                ids = [rid for rid, _, _t in batch]
+                exchanges = [ex for _, ex, _t in batch]
                 with self._model_lock:
                     self._run_batch(ids, exchanges)
 
