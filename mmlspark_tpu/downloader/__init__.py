@@ -1,0 +1,11 @@
+"""Model zoo: schemas, hash-checked repositories, and the ModelDownloader.
+
+Reference: downloader/src/main/scala/ModelDownloader.scala:209-267
+(Repository/ModelDownloader), Schema.scala (ModelSchema with uri/hash/size +
+inputNode/numLayers/layerNames consumed by ImageFeaturizer.scala:73-77).
+"""
+
+from mmlspark_tpu.downloader.schema import ModelSchema
+from mmlspark_tpu.downloader.downloader import ModelDownloader, default_zoo_dir
+
+__all__ = ["ModelSchema", "ModelDownloader", "default_zoo_dir"]

@@ -1,0 +1,188 @@
+"""ModelDownloader: fetch zoo models into a local hash-verified repository.
+
+Reference: downloader/src/main/scala/ModelDownloader.scala:209-267 — a
+Repository abstraction (remoteModels / localModels / downloadModel /
+downloadByName) whose remote side lists MANIFEST-described CNTK checkpoints
+and whose local side maintains a directory of verified copies. Same design
+here over Network directories; "remote" is any other on-disk repository (the
+committed in-repo zoo by default — this build has zero egress, so http(s)
+URIs are rejected at ModelSchema.local_path with a clear message).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Dict, Iterator, List, Optional
+
+from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.downloader.schema import (
+    ModelSchema,
+    hash_model_dir,
+    model_dir_size,
+)
+
+log = get_logger("mmlspark_tpu.downloader")
+
+_MANIFEST = "MANIFEST.json"
+
+
+def default_zoo_dir() -> str:
+    """The committed in-repo zoo (tools/make_zoo.py populates it)."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(here, "models_zoo")
+
+
+class ModelDownloader:
+    """Maintains `local_path` as a repository of hash-verified models.
+
+    downloader = ModelDownloader(local_path)
+    schema = downloader.download_by_name("ConvNet")   # from the default zoo
+    bundle = downloader.load_bundle(schema)           # NetworkBundle
+    """
+
+    def __init__(self, local_path: str, repo_uri: Optional[str] = None):
+        self.local_path = os.path.abspath(local_path)
+        self.repo_uri = repo_uri or default_zoo_dir()
+        os.makedirs(self.local_path, exist_ok=True)
+
+    # -- listings --------------------------------------------------------------
+
+    def remote_models(self) -> Iterator[ModelSchema]:
+        """Schemas advertised by the remote repository's MANIFEST."""
+        repo = self.repo_uri
+        if repo.startswith("file://"):
+            repo = repo[len("file://"):]
+        manifest = os.path.join(repo, _MANIFEST)
+        if not os.path.exists(manifest):
+            return iter(())
+        with open(manifest) as f:
+            entries = json.load(f)
+
+        def resolve(d: Dict) -> ModelSchema:
+            s = ModelSchema.from_dict(d)
+            if "://" not in s.uri and not os.path.isabs(s.uri):
+                s = s.with_uri(os.path.join(repo, s.uri))
+            return s
+
+        return iter([resolve(d) for d in entries])
+
+    def local_models(self) -> Iterator[ModelSchema]:
+        manifest = os.path.join(self.local_path, _MANIFEST)
+        if not os.path.exists(manifest):
+            return iter(())
+        with open(manifest) as f:
+            return iter([ModelSchema.from_dict(d) for d in json.load(f)])
+
+    # -- fetch -----------------------------------------------------------------
+
+    def download_model(self, schema: ModelSchema) -> ModelSchema:
+        """Copy the model into the local repository, verify sha256, record it
+        in the local MANIFEST, and return the schema re-pointed locally. A
+        hash-matching local copy short-circuits (reference: the repository
+        only re-fetches on hash mismatch)."""
+        dest = os.path.join(self.local_path, schema.filename)
+        if os.path.isdir(dest):
+            try:
+                schema.assert_matching_hash(dest)
+                return schema.with_uri(dest)
+            except ValueError:
+                log.info("local copy of %s stale; re-fetching", schema.name)
+                shutil.rmtree(dest)
+        src = schema.local_path()
+        if not os.path.isdir(src):
+            raise FileNotFoundError(f"model source {src!r} is not a directory")
+        shutil.copytree(src, dest)
+        try:
+            schema.assert_matching_hash(dest)
+        except ValueError:
+            shutil.rmtree(dest, ignore_errors=True)
+            raise
+        local = schema.with_uri(dest)
+        self._record(local)
+        return local
+
+    def download_by_name(self, name: str) -> ModelSchema:
+        for s in self.remote_models():
+            if s.name == name:
+                return self.download_model(s)
+        known = [s.name for s in self.remote_models()]
+        raise KeyError(f"no model named {name!r} in {self.repo_uri}; have {known}")
+
+    def download_models(self) -> List[ModelSchema]:
+        return [self.download_model(s) for s in self.remote_models()]
+
+    def load_bundle(self, schema: ModelSchema):
+        """ModelSchema -> NetworkBundle (verifying the local copy)."""
+        from mmlspark_tpu.dnn.network import NetworkBundle
+
+        path = schema.local_path()
+        schema.assert_matching_hash(path)
+        return NetworkBundle.load_from_dir(path)
+
+    # -- publishing (zoo maintenance, used by tools/make_zoo.py) ---------------
+
+    @staticmethod
+    def publish(
+        model_dir: str,
+        repo_dir: str,
+        *,
+        name: str,
+        dataset: str,
+        model_type: str = "image",
+        input_node: int = 0,
+        layer_names: Optional[List[str]] = None,
+        extra: Optional[Dict] = None,
+    ) -> ModelSchema:
+        """Copy a saved Network dir into a repository and MANIFEST it."""
+        schema = ModelSchema(
+            name=name,
+            dataset=dataset,
+            model_type=model_type,
+            uri="",  # patched below
+            hash="",
+            size=0,
+            input_node=input_node,
+            num_layers=len(layer_names or []),
+            layer_names=list(layer_names or []),
+            extra=dict(extra or {}),
+        )
+        dest = os.path.join(repo_dir, schema.filename)
+        os.makedirs(repo_dir, exist_ok=True)
+        if os.path.isdir(dest):
+            shutil.rmtree(dest)
+        shutil.copytree(model_dir, dest)
+        schema = ModelSchema(
+            name=name,
+            dataset=dataset,
+            model_type=model_type,
+            uri=schema.filename,  # manifest-relative
+            hash=hash_model_dir(dest),
+            size=model_dir_size(dest),
+            input_node=input_node,
+            num_layers=len(layer_names or []),
+            layer_names=list(layer_names or []),
+            extra=dict(extra or {}),
+        )
+        manifest = os.path.join(repo_dir, _MANIFEST)
+        entries = []
+        if os.path.exists(manifest):
+            with open(manifest) as f:
+                entries = [e for e in json.load(f) if e.get("name") != name]
+        entries.append(schema.to_dict())
+        with open(manifest, "w") as f:
+            json.dump(entries, f, indent=1)
+        return schema
+
+    def _record(self, schema: ModelSchema) -> None:
+        manifest = os.path.join(self.local_path, _MANIFEST)
+        entries = []
+        if os.path.exists(manifest):
+            with open(manifest) as f:
+                entries = [
+                    e for e in json.load(f) if e.get("name") != schema.name
+                ]
+        entries.append(schema.to_dict())
+        with open(manifest, "w") as f:
+            json.dump(entries, f, indent=1)

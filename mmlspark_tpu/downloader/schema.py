@@ -1,0 +1,125 @@
+"""Model schemas for the zoo repository.
+
+Reference: downloader/src/main/scala/Schema.scala — ModelSchema(name,
+dataset, modelType, uri, hash, size, inputNode, numLayers, layerNames) with
+sha256 verification (assertMatchingHash). The reference's models are single
+CNTK protobuf files; ours are Network directories (spec.json +
+variables.npz, dnn/network.py save_to_dir), so the hash covers every file in
+sorted relative-path order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+def hash_model_dir(path: str) -> str:
+    """sha256 over all files under `path` in sorted relative order (file
+    names participate, so renames change the hash)."""
+    h = hashlib.sha256()
+    for rel in sorted(_walk_files(path)):
+        h.update(rel.encode("utf-8"))
+        with open(os.path.join(path, rel), "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+    return h.hexdigest()
+
+
+def model_dir_size(path: str) -> int:
+    return sum(
+        os.path.getsize(os.path.join(path, rel)) for rel in _walk_files(path)
+    )
+
+
+def _walk_files(path: str) -> List[str]:
+    out = []
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            out.append(os.path.relpath(os.path.join(root, name), path))
+    return out
+
+
+@dataclasses.dataclass
+class ModelSchema:
+    """One zoo entry. layer_names are ordered OUTPUT -> INPUT (the first
+    entry is the output layer), matching the reference contract
+    ImageFeaturizer.scala:117-119 so cut_output_layers indexes directly."""
+
+    name: str
+    dataset: str
+    model_type: str
+    uri: str          # local path or file:// URI of the model directory
+    hash: str         # sha256 (hash_model_dir)
+    size: int
+    input_node: int = 0
+    num_layers: int = 0
+    layer_names: List[str] = dataclasses.field(default_factory=list)
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def filename(self) -> str:
+        """Canonical local name (NamingConventions.canonicalModelFilename)."""
+        return f"{self.name}_{self.dataset}.model"
+
+    def local_path(self) -> str:
+        uri = self.uri
+        if uri.startswith("file://"):
+            return uri[len("file://"):]
+        if "://" in uri:
+            raise ValueError(
+                f"non-local model uri {uri!r}: this build has no network "
+                "egress; place the model dir on disk and use a file:// uri"
+            )
+        return uri
+
+    def assert_matching_hash(self, path: str) -> None:
+        actual = hash_model_dir(path)
+        if actual != self.hash:
+            raise ValueError(
+                f"downloaded hash: {actual} does not match given hash: {self.hash}"
+            )
+
+    def with_uri(self, uri: str) -> "ModelSchema":
+        return dataclasses.replace(self, uri=uri)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "dataset": self.dataset,
+            "modelType": self.model_type,
+            "uri": self.uri,
+            "hash": self.hash,
+            "size": self.size,
+            "inputNode": self.input_node,
+            "numLayers": self.num_layers,
+            "layerNames": list(self.layer_names),
+            **({"extra": self.extra} if self.extra else {}),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelSchema":
+        return cls(
+            name=d["name"],
+            dataset=d["dataset"],
+            model_type=d.get("modelType", d.get("model_type", "image")),
+            uri=d["uri"],
+            hash=d["hash"],
+            size=int(d["size"]),
+            input_node=int(d.get("inputNode", d.get("input_node", 0))),
+            num_layers=int(d.get("numLayers", d.get("num_layers", 0))),
+            layer_names=list(d.get("layerNames", d.get("layer_names", []))),
+            extra=dict(d.get("extra", {})),
+        )
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=1)
+
+    @classmethod
+    def load(cls, path: str) -> "ModelSchema":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))

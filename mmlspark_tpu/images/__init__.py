@@ -1,7 +1,9 @@
-"""images — image pipeline stages.
+"""images — image pipeline stages, featurization, and interpretability.
 
-Equivalent of the reference's image-transformer module (OpenCV-backed,
-SURVEY.md §2.2): ImageTransformer.scala:22-335, UnrollImage.scala:25-49.
+Equivalent of the reference's image-transformer AND image-featurizer modules
+(SURVEY.md §2.2): ImageTransformer.scala:22-335, UnrollImage.scala:25-49,
+ImageFeaturizer.scala:129-177, ImageLIME.scala:75-163,
+Superpixel.scala:154-273, SuperpixelTransformer.scala:33.
 
 Design note: pre-resize images are ragged (per-row sizes differ), so the
 transform ops run per-row on host in numpy — exactly where the reference
@@ -16,11 +18,23 @@ from mmlspark_tpu.images.transformer import (
     UnrollBinaryImage,
     UnrollImage,
 )
+from mmlspark_tpu.images.featurizer import ImageFeaturizer
+from mmlspark_tpu.images.lime import ImageLIME
+from mmlspark_tpu.images.superpixel import (
+    Superpixel,
+    SuperpixelData,
+    SuperpixelTransformer,
+)
 
 __all__ = [
+    "ImageFeaturizer",
+    "ImageLIME",
     "ImageSetAugmenter",
     "ImageTransformer",
     "ResizeImageTransformer",
+    "Superpixel",
+    "SuperpixelData",
+    "SuperpixelTransformer",
     "UnrollBinaryImage",
     "UnrollImage",
 ]

@@ -1,0 +1,187 @@
+"""ImageFeaturizer: headless DNN featurization of image columns.
+
+Reference: image-featurizer/src/main/scala/ImageFeaturizer.scala:129-177 —
+resize/unroll the image column to the model's input shape, truncate the
+network `cut_output_layers` layers from the output (layer_names[cut] names
+the new output node), run the inner model, emit a VECTOR column. setModel
+consumes a downloader ModelSchema (:73-77), wiring layerNames + inputNode.
+
+TPU notes: the heavy path is the inner TPUModel's jit minibatch eval
+(models/tpu_model.py) — one compiled program per (truncated spec, batch),
+bfloat16-able, windowed H2D. The featurizer itself is glue.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Union
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType, Field
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    Param,
+    TypeConverters,
+    Wrappable,
+)
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.dnn.network import NetworkBundle
+from mmlspark_tpu.images.transformer import (
+    ResizeImageTransformer,
+    UnrollBinaryImage,
+    UnrollImage,
+)
+from mmlspark_tpu.models.tpu_model import TPUModel
+
+
+class ImageFeaturizer(Transformer, Wrappable):
+    """Featurize an image (or binary) column through a truncated network.
+
+    cut_output_layers=0 leaves the network intact; 1 (default) removes the
+    output layer so the penultimate activations become the features — the
+    transfer-learning configuration.
+    """
+
+    model = ComplexParam("model", "The NetworkBundle used in the featurizer")
+    input_col = Param("input_col", "The name of the input column", TypeConverters.to_string)
+    output_col = Param("output_col", "The name of the output column", TypeConverters.to_string)
+    cut_output_layers = Param(
+        "cut_output_layers",
+        "The number of layers to cut off the end of the network; 0 leaves "
+        "the network intact, 1 removes the output layer, etc",
+        TypeConverters.to_int,
+    )
+    layer_names = Param(
+        "layer_names",
+        "Named layers to choose from; the first entries of this array "
+        "should be closer to the output node",
+        TypeConverters.to_list,
+    )
+    drop_na = Param(
+        "drop_na", "Whether to drop null images before mapping",
+        TypeConverters.to_boolean,
+    )
+    mini_batch_size = Param(
+        "mini_batch_size", "Rows per device dispatch", TypeConverters.to_int
+    )
+
+    def __init__(
+        self,
+        model: Optional[Any] = None,
+        input_col: str = "image",
+        output_col: Optional[str] = None,
+        cut_output_layers: int = 1,
+    ):
+        super().__init__()
+        self._set_defaults(
+            input_col="image",
+            output_col="features",
+            cut_output_layers=1,
+            drop_na=True,
+            mini_batch_size=64,
+        )
+        if model is not None:
+            self.set_model(model)
+        self.set(self.input_col, input_col)
+        if output_col is not None:
+            self.set(self.output_col, output_col)
+        self.set(self.cut_output_layers, cut_output_layers)
+
+    # -- fluent setters --------------------------------------------------------
+
+    def set_model(self, value: Union[NetworkBundle, "ModelSchema"]) -> "ImageFeaturizer":
+        """Accepts a NetworkBundle directly, or a downloader ModelSchema
+        (reference setModel(modelSchema), ImageFeaturizer.scala:73-77) whose
+        layerNames and uri wire the featurizer in one call."""
+        from mmlspark_tpu.downloader.schema import ModelSchema
+
+        if isinstance(value, ModelSchema):
+            self.set_layer_names(list(value.layer_names))
+            bundle = NetworkBundle.load_from_dir(value.local_path())
+            return self.set(self.model, bundle)
+        if not isinstance(value, NetworkBundle):
+            raise TypeError("set_model expects a NetworkBundle or ModelSchema")
+        return self.set(self.model, value)
+
+    def get_model(self) -> NetworkBundle:
+        return self.get(self.model)
+
+    def set_input_col(self, v: str):
+        return self.set(self.input_col, v)
+
+    def set_output_col(self, v: str):
+        return self.set(self.output_col, v)
+
+    def set_cut_output_layers(self, v: int):
+        return self.set(self.cut_output_layers, v)
+
+    def set_layer_names(self, v: List[str]):
+        return self.set(self.layer_names, v)
+
+    def set_mini_batch_size(self, v: int):
+        return self.set(self.mini_batch_size, v)
+
+    # -- helpers ---------------------------------------------------------------
+
+    def _effective_layer_names(self) -> List[str]:
+        """Output->input order. Defaults to the bundle network's own layer
+        names reversed, so cut_output_layers indexes straight into it."""
+        if self.is_set(self.layer_names):
+            return list(self.get(self.layer_names))
+        return list(reversed(self.get_model().network.layer_names))
+
+    def _output_layer(self) -> Optional[str]:
+        cut = self.get(self.cut_output_layers)
+        if cut == 0:
+            return None  # intact network
+        names = self._effective_layer_names()
+        if not 0 <= cut < len(names):
+            raise ValueError(
+                f"cut_output_layers={cut} out of range for {len(names)} layers"
+            )
+        return names[cut]
+
+    # -- stage contract --------------------------------------------------------
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [Field(self.get(self.output_col), DataType.VECTOR)]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        in_col = self.get(self.input_col)
+        bundle = self.get_model()
+        h, w = bundle.network.input_shape[0], bundle.network.input_shape[1]
+        resized = "__resized__"
+
+        if self.get(self.drop_na):
+            keep = np.array([v is not None for v in df[in_col]], bool)
+            if not keep.all():
+                df = df.filter(keep)
+
+        dtype = df.dtype(in_col)
+        if dtype == DataType.STRUCT:
+            prepared = (
+                ResizeImageTransformer(in_col, "__prep__", height=h, width=w)
+                .transform(df)
+            )
+            unrolled = UnrollImage("__prep__", resized).transform(prepared)
+            unrolled = unrolled.drop("__prep__")
+        elif dtype == DataType.BINARY:
+            unrolled = UnrollBinaryImage(
+                in_col, resized, height=h, width=w
+            ).transform(df)
+        else:
+            raise ValueError(
+                f"input column {in_col!r} needs image STRUCT or BINARY type, "
+                f"got {dtype.value}"
+            )
+
+        inner = TPUModel(
+            bundle,
+            input_col=resized,
+            output_col=self.get(self.output_col),
+            mini_batch_size=self.get(self.mini_batch_size),
+        )
+        out_layer = self._output_layer()
+        if out_layer is not None:
+            inner.set_output_layer(out_layer)
+        return inner.transform(unrolled).drop(resized)

@@ -1,0 +1,113 @@
+"""Build the committed model zoo (models_zoo/).
+
+Trains ConvNet_patches: a small convnet on the synthetic two-patch XOR task
+(class = XOR of two bright-patch indicators) — a task linear raw-pixel
+models CANNOT solve, so transfer-learning tests can prove the featurizer's
+penultimate activations carry non-linear information (the role the
+reference's CNTK zoo models play for ImageFeaturizerSuite).
+
+Run from the repo root:  python tools/make_zoo.py
+Deterministic (fixed seeds) so the committed hash is reproducible.
+
+Reference: downloader ModelDownloader.scala:209-267 (the zoo this seeds),
+ImageFeaturizer.scala:73-79 (layerNames consumption).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.dnn.network import Network
+from mmlspark_tpu.downloader import ModelDownloader
+from mmlspark_tpu.models.tpu_learner import TPULearner
+
+H = W = 32
+PATCH = 8
+
+
+def make_patch_xor(n: int, seed: int = 0):
+    """Images with optional bright patches at top-left / bottom-right;
+    label = XOR of the two patch indicators."""
+    rng = np.random.default_rng(seed)
+    imgs = rng.integers(0, 60, size=(n, H, W, 3)).astype(np.uint8)
+    p1 = rng.integers(0, 2, n).astype(bool)
+    p2 = rng.integers(0, 2, n).astype(bool)
+    imgs[p1, 4:4 + PATCH, 4:4 + PATCH] = 220
+    imgs[p2, 20:20 + PATCH, 20:20 + PATCH] = 220
+    labels = (p1 ^ p2).astype(np.float64)
+    return imgs, labels
+
+
+def patch_net() -> Network:
+    spec = [
+        {"kind": "conv", "name": "conv1", "filters": 8, "kernel": 5, "stride": 2},
+        {"kind": "batchnorm", "name": "bn1"},
+        {"kind": "relu", "name": "relu1"},
+        {"kind": "conv", "name": "conv2", "filters": 16, "kernel": 3, "stride": 2},
+        {"kind": "batchnorm", "name": "bn2"},
+        {"kind": "relu", "name": "relu2"},
+        {"kind": "global_avg_pool", "name": "pool"},
+        {"kind": "flatten", "name": "flat"},
+        {"kind": "dense", "name": "hidden", "units": 32},
+        {"kind": "relu", "name": "relu3"},
+        {"kind": "dense", "name": "z", "units": 2},
+    ]
+    return Network(spec, input_shape=(H, W, 3))
+
+
+def main() -> None:
+    imgs, labels = make_patch_xor(3000, seed=0)
+    # RAW 0-255 pixel scale: ImageFeaturizer feeds unrolled uint8 pixels, so
+    # the published model must own its input scale (the reference's CNTK zoo
+    # models likewise embed their preprocessing)
+    x = imgs.reshape(len(imgs), -1).astype(np.float32)
+    df = DataFrame.from_dict({"features": x, "label": labels})
+
+    learner = TPULearner(
+        patch_net(),
+        loss="softmax_cross_entropy",
+        optimizer="adam",
+        learning_rate=3e-3,
+        epochs=12,
+        batch_size=128,
+        seed=0,
+    )
+    model = learner.fit(df)
+    bundle = model.get_model()
+
+    # quick train-accuracy report (should be ~1.0; XOR is unlearnable
+    # linearly, so >0.9 proves the conv trunk learned the interaction)
+    scores = model.transform(df)["scores"]
+    acc = float((np.argmax(scores, axis=1) == labels).mean())
+    print(f"train accuracy: {acc:.4f}")
+    if acc < 0.95:
+        raise SystemExit("zoo model underfit; not publishing")
+
+    tmp = os.path.join("/tmp", "zoo_build", "ConvNet_patches")
+    os.makedirs(os.path.dirname(tmp), exist_ok=True)
+    bundle.save_to_dir(tmp)
+
+    repo_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "models_zoo"
+    )
+    schema = ModelDownloader.publish(
+        tmp,
+        repo_dir,
+        name="ConvNet",
+        dataset="patches",
+        model_type="image",
+        input_node=0,
+        # output -> input order (ImageFeaturizer cut_output_layers indexes it):
+        layer_names=["z", "relu3", "hidden", "flat", "pool"],
+        extra={"accuracy": acc, "task": "patch-xor", "input_shape": [H, W, 3]},
+    )
+    print(f"published {schema.name}_{schema.dataset}: hash={schema.hash[:12]}... "
+          f"size={schema.size}B")
+
+
+if __name__ == "__main__":
+    main()
