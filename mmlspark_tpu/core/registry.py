@@ -1,0 +1,65 @@
+"""Stage registry: enumerate every pipeline stage in the library.
+
+Reference: core/utils JarLoadingUtils classpath scan that seeds FuzzingTest
+(core/test/fuzzing/src/test/scala/FuzzingTest.scala:15-56) and codegen
+(codegen/src/main/scala/CodeGen.scala:44-98). The Python analog is an
+import-walk over the package: every concrete public subclass of
+PipelineStage is registered, and the fuzzing sweep (tests/test_fuzzing.py)
+asserts each one is either exercised or explicitly exempted — nothing ships
+untested by omission.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import pkgutil
+from typing import Dict, List, Type
+
+# Abstract surface (never registered): the pipeline contract classes and
+# param-holder bases.
+_BASE_NAMES = {
+    "PipelineStage", "Transformer", "Estimator", "Model",
+    "Pipeline", "PipelineModel",
+}
+
+
+def all_stage_classes(refresh: bool = False) -> Dict[str, Type]:
+    """{fully.qualified.Name: class} for every concrete public stage.
+
+    Walks (and imports) every module under mmlspark_tpu, so the result is
+    complete regardless of what the caller already imported.
+    """
+    global _CACHE
+    if _CACHE is not None and not refresh:
+        return dict(_CACHE)
+    import mmlspark_tpu
+    from mmlspark_tpu.core.pipeline import PipelineStage
+
+    out: Dict[str, Type] = {}
+    for modinfo in pkgutil.walk_packages(
+        mmlspark_tpu.__path__, prefix="mmlspark_tpu."
+    ):
+        try:
+            mod = importlib.import_module(modinfo.name)
+        except Exception as e:  # pragma: no cover - import failure is a bug
+            raise ImportError(f"registry cannot import {modinfo.name}: {e!r}")
+        for name, obj in vars(mod).items():
+            if (
+                inspect.isclass(obj)
+                and issubclass(obj, PipelineStage)
+                and obj.__module__ == modinfo.name  # defining module only
+                and not name.startswith("_")
+                and name not in _BASE_NAMES
+                and not inspect.isabstract(obj)
+            ):
+                out[f"{obj.__module__}.{name}"] = obj
+    _CACHE = dict(out)
+    return out
+
+
+_CACHE = None
+
+
+def stage_names() -> List[str]:
+    return sorted(all_stage_classes())

@@ -165,6 +165,21 @@ def _init_is_arg_free(cls) -> bool:
 # -- complex value dispatch ---------------------------------------------------
 
 
+def _json_keys_safe(value: Any) -> bool:
+    """True when JSON encoding round-trips exactly: every dict key
+    (recursively) is already a str and no tuples (JSON would reload them
+    as lists)."""
+    if isinstance(value, dict):
+        return all(
+            isinstance(k, str) and _json_keys_safe(v) for k, v in value.items()
+        )
+    if isinstance(value, tuple):
+        return False
+    if isinstance(value, list):
+        return all(_json_keys_safe(v) for v in value)
+    return True
+
+
 def _save_complex(value: Any, directory: str, name: str) -> str:
     if isinstance(value, list) and value and all(isinstance(v, Params) for v in value):
         sub = os.path.join(directory, name)
@@ -184,16 +199,24 @@ def _save_complex(value: Any, directory: str, name: str) -> str:
     if isinstance(value, np.ndarray):
         np.save(os.path.join(directory, f"{name}.npy"), value, allow_pickle=False)
         return "ndarray"
-    if isinstance(value, dict) and all(isinstance(v, np.ndarray) for v in value.values()):
+    if (
+        isinstance(value, dict)
+        and all(isinstance(k, str) for k in value)  # np.savez(**) needs str keys
+        and all(isinstance(v, np.ndarray) for v in value.values())
+    ):
         np.savez(os.path.join(directory, f"{name}.npz"), **value)
         return "ndarray_dict"
     if isinstance(value, (str, int, float, bool, list, dict, type(None))):
-        try:
-            with open(os.path.join(directory, f"{name}.json"), "w") as f:
-                json.dump(value, f)
-            return "json"
-        except TypeError:
-            pass
+        # json.dump silently STRINGIFIES non-str dict keys (float 1.0 ->
+        # "1.0"), corrupting lookup tables like ClassBalancerModel.weights;
+        # only JSON-encode values that round-trip exactly
+        if _json_keys_safe(value):
+            try:
+                with open(os.path.join(directory, f"{name}.json"), "w") as f:
+                    json.dump(value, f)
+                return "json"
+            except TypeError:
+                pass
     if hasattr(value, "save_to_dir") and hasattr(type(value), "load_from_dir"):
         sub = os.path.join(directory, name)
         os.makedirs(sub, exist_ok=True)

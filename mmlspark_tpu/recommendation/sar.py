@@ -36,6 +36,8 @@ def _score(a, s):
 
 
 class SAR(Estimator, Wrappable):
+    """Smart Adaptive Recommendations estimator: item-item similarity + time-decayed user affinity (SAR.scala:64-188)."""
+
     user_col = Param("user_col", "User id column (integer-indexed)", TypeConverters.to_string)
     item_col = Param("item_col", "Item id column (integer-indexed)", TypeConverters.to_string)
     rating_col = Param("rating_col", "Rating column", TypeConverters.to_string)
@@ -49,16 +51,35 @@ class SAR(Estimator, Wrappable):
     time_decay_coeff = Param(
         "time_decay_coeff", "Affinity half-life in days", TypeConverters.to_int
     )
+    start_time = Param(
+        "start_time", "Custom reference 'now' for historical data "
+        "(reference SAR.scala:236-238 startTime); default: max activity time",
+        TypeConverters.to_string,
+    )
+    start_time_format = Param(
+        "start_time_format", "strptime format for start_time "
+        "(Python format strings, not Java SimpleDateFormat)",
+        TypeConverters.to_string,
+    )
+    activity_time_format = Param(
+        "activity_time_format", "strptime format for string time columns",
+        TypeConverters.to_string,
+    )
 
     def __init__(self, user_col: str = "user_idx", item_col: str = "item_idx",
                  rating_col: str = "rating", time_col: Optional[str] = None,
                  similarity_function: str = "jaccard", support_threshold: int = 4,
-                 time_decay_coeff: int = 30):
+                 time_decay_coeff: int = 30,
+                 start_time: Optional[str] = None):
         super().__init__()
         self._set_defaults(
             user_col="user_idx", item_col="item_idx", rating_col="rating",
             similarity_function="jaccard", support_threshold=4, time_decay_coeff=30,
+            start_time_format="%Y/%m/%dT%H:%M:%S",
+            activity_time_format="%Y/%m/%dT%H:%M:%S",
         )
+        if start_time:
+            self.set(self.start_time, start_time)
         self.set(self.user_col, user_col)
         self.set(self.item_col, item_col)
         self.set(self.rating_col, rating_col)
@@ -83,16 +104,46 @@ class SAR(Estimator, Wrappable):
         n_users = int(users.max()) + 1 if len(users) else 0
         n_items = int(items.max()) + 1 if len(items) else 0
 
-        # time-decayed affinity: a(u,i) = sum_k r_k * 2^(-(t_ref - t_k)/T)
+        # time-decayed affinity: a(u,i) = sum_k r_k * 2^(-(t_ref - t_k)/T).
+        # Differences quantize to whole MINUTES before the exponent — the
+        # upstream truncation (SAR.scala:87-91 divides epoch-ms by 1000*60 in
+        # Long arithmetic), kept so affinities match reference fixtures bit
+        # for bit.
         if self.is_set(self.time_col):
             t = df[self.get(self.time_col)]
-            if t.dtype.kind == "M":
+            if t.dtype == object or t.dtype.kind in "SU":
+                from datetime import datetime, timezone
+
+                # UTC-pin parsed timestamps: naive strptime().timestamp()
+                # would apply the machine's local DST rules, shifting decay
+                # across a DST boundary by a whole minute bucket
+                fmt = self.get(self.activity_time_format)
+                t = np.array(
+                    [
+                        datetime.strptime(str(v), fmt)
+                        .replace(tzinfo=timezone.utc).timestamp()
+                        for v in t
+                    ],
+                    np.float64,
+                )
+            elif t.dtype.kind == "M":
                 t = t.astype("datetime64[s]").astype(np.float64)
             else:
                 t = t.astype(np.float64)
-            halflife_s = self.get(self.time_decay_coeff) * 86400.0
-            t_ref = float(t.max())
-            decay = np.power(2.0, -(t_ref - t) / halflife_s)
+            if self.is_set(self.start_time):
+                from datetime import datetime, timezone
+
+                t_ref = datetime.strptime(
+                    self.get(self.start_time), self.get(self.start_time_format)
+                ).replace(tzinfo=timezone.utc).timestamp()
+            else:
+                t_ref = float(t.max())
+            halflife_min = self.get(self.time_decay_coeff) * 24.0 * 60.0
+            # trunc of the DIFFERENCE (not per-timestamp): the reference
+            # computes (refMs - actMs) / 60000 in Long arithmetic
+            # (SAR.scala:89) — subtraction first, truncating division after
+            diff_min = np.trunc((t_ref - t) / 60.0)
+            decay = np.power(2.0, -diff_min / halflife_min)
         else:
             decay = np.ones(len(df))
 
@@ -128,6 +179,8 @@ class SAR(Estimator, Wrappable):
 
 
 class SARModel(Model, Wrappable):
+    """Fitted SAR: scores = user affinity @ item similarity; top-k with seen-item masking (SARModel.scala:141)."""
+
     user_col = Param("user_col", "User id column", TypeConverters.to_string)
     item_col = Param("item_col", "Item id column", TypeConverters.to_string)
     rating_col = Param("rating_col", "Rating column", TypeConverters.to_string)

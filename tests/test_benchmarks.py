@@ -1,0 +1,167 @@
+"""Committed quality-guard benchmarks (the reference's Benchmarks pattern:
+core/test/benchmarks/src/main/scala/Benchmarks.scala:35 — metric values live
+in a committed CSV; a run that drifts fails and prints the new table).
+
+Regenerate after an intentional change with:
+    MMLSPARK_TPU_REGEN_BENCHMARKS=1 python -m pytest tests/test_benchmarks.py
+then commit the updated tests/resources/quality_benchmarks.csv.
+"""
+
+import csv
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+
+CSV_PATH = os.path.join(
+    os.path.dirname(__file__), "resources", "quality_benchmarks.csv"
+)
+REGEN = os.environ.get("MMLSPARK_TPU_REGEN_BENCHMARKS") == "1"
+ATOL = 2e-3  # metric drift tolerance (all metrics are 0..1 or small RMSE)
+
+
+def _binary_df(n=800, d=10, seed=11):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, n).astype(np.float64)
+    x = rng.normal(size=(n, d))
+    x[:, 0] += 1.6 * y
+    x[:, 1] -= 1.2 * y
+    x[:, 2] += y * x[:, 3]
+    return DataFrame.from_dict({"features": x, "label": y}), y
+
+
+def _auc(y, s):
+    order = np.argsort(s)
+    ranks = np.empty(len(s))
+    ranks[order] = np.arange(1, len(s) + 1)
+    pos = y > 0
+    return (ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2) / (
+        pos.sum() * (~pos).sum()
+    )
+
+
+def bench_gbdt_binary_auc():
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+
+    df, y = _binary_df()
+    m = LightGBMClassifier(num_iterations=40, num_leaves=15).fit(df)
+    return _auc(y, m.transform(df)["probability"][:, 1])
+
+
+def bench_gbdt_rf_auc():
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+
+    df, y = _binary_df()
+    m = LightGBMClassifier(
+        num_iterations=30, num_leaves=15, boosting_type="rf",
+        bagging_fraction=0.7, bagging_freq=1,
+    ).fit(df)
+    return _auc(y, m.transform(df)["probability"][:, 1])
+
+
+def bench_gbdt_regression_rmse():
+    from mmlspark_tpu.gbdt import LightGBMRegressor
+
+    rng = np.random.default_rng(12)
+    x = rng.normal(size=(800, 8))
+    y = x[:, 0] * 2 + np.sin(x[:, 1] * 2) + 0.1 * rng.normal(size=800)
+    df = DataFrame.from_dict({"features": x, "label": y})
+    m = LightGBMRegressor(num_iterations=60, num_leaves=31).fit(df)
+    pred = m.transform(df)["prediction"]
+    return float(np.sqrt(np.mean((pred - y) ** 2)))
+
+
+def bench_gbdt_multiclass_accuracy():
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+
+    rng = np.random.default_rng(13)
+    y = rng.integers(0, 3, 600).astype(np.float64)
+    x = rng.normal(size=(600, 6))
+    for k in range(3):
+        x[y == k, k] += 2.0
+    df = DataFrame.from_dict({"features": x, "label": y})
+    m = LightGBMClassifier(num_iterations=25, num_leaves=7).fit(df)
+    pred = m.transform(df)["prediction"]
+    return float((pred == y).mean())
+
+
+def bench_train_classifier_accuracy():
+    from mmlspark_tpu.automl.train import TrainClassifier
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+
+    rng = np.random.default_rng(14)
+    n = 500
+    y = rng.integers(0, 2, n).astype(np.float64)
+    num = rng.normal(size=n) + y
+    cat = np.array(["x", "y", "z", "w"], object)[rng.integers(0, 4, n)]
+    df = DataFrame.from_dict(
+        {"num": num, "cat": cat, "label": y},
+    )
+    m = TrainClassifier(
+        model=LightGBMClassifier(num_iterations=20, num_leaves=7),
+        label_col="label",
+    ).fit(df)
+    out = m.transform(df)
+    return float((out["scored_labels"] == y).mean())
+
+
+def bench_sar_jaccard_checksum():
+    """Checksum of golden-fixture SAR scores (affinity @ similarity) — the
+    decay path feeds affinity, so decay regressions move this number."""
+    from tests.test_sar_golden import _Fixture
+
+    fx = _Fixture()
+    scores = fx.fit_sar(3, "jaccard")._scores()
+    return float(np.asarray(scores, np.float64).sum())
+
+
+BENCHMARKS = {
+    "gbdt_binary_auc": bench_gbdt_binary_auc,
+    "gbdt_rf_auc": bench_gbdt_rf_auc,
+    "gbdt_regression_rmse": bench_gbdt_regression_rmse,
+    "gbdt_multiclass_accuracy": bench_gbdt_multiclass_accuracy,
+    "train_classifier_accuracy": bench_train_classifier_accuracy,
+    "sar_jaccard_checksum": bench_sar_jaccard_checksum,
+}
+
+
+def _load_committed():
+    if not os.path.exists(CSV_PATH):
+        return {}
+    with open(CSV_PATH) as f:
+        return {r["name"]: float(r["value"]) for r in csv.DictReader(f)}
+
+
+def _write_committed(values):
+    with open(CSV_PATH, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["name", "value"])
+        for k in sorted(values):
+            w.writerow([k, repr(float(values[k]))])
+
+
+@pytest.mark.skipif(REGEN, reason="regenerating benchmark table")
+@pytest.mark.parametrize("name", sorted(BENCHMARKS))
+def test_quality_benchmark(name):
+    committed = _load_committed()
+    assert name in committed, (
+        f"no committed value for {name}; run with "
+        "MMLSPARK_TPU_REGEN_BENCHMARKS=1 and commit the CSV"
+    )
+    value = BENCHMARKS[name]()
+    assert abs(value - committed[name]) <= ATOL, (
+        f"{name} drifted: {value!r} vs committed {committed[name]!r}"
+    )
+
+
+@pytest.mark.skipif(not REGEN, reason="set MMLSPARK_TPU_REGEN_BENCHMARKS=1")
+def test_regenerate_benchmarks():
+    _write_committed({k: fn() for k, fn in BENCHMARKS.items()})
+
+
+def test_no_stale_benchmark_rows():
+    committed = _load_committed()
+    stale = set(committed) - set(BENCHMARKS)
+    assert not stale, f"committed benchmarks with no generator: {stale}"
