@@ -41,6 +41,8 @@ def evaluate_scored(df: DataFrame, label_col: str, metric: str) -> float:
 
 
 class FindBestModel(Estimator, HasEvaluationMetric, Wrappable):
+    """Evaluate candidate models on a validation metric and keep the best (FindBestModel.scala:43-95)."""
+
     models = ComplexParam("models", "Candidate trained models")
 
     def __init__(self, models: Optional[List[Transformer]] = None,
@@ -93,6 +95,8 @@ class FindBestModel(Estimator, HasEvaluationMetric, Wrappable):
 
 
 class BestModel(Model, HasEvaluationMetric, Wrappable):
+    """The winning model plus all-candidate metrics and ROC data (FindBestModel.scala bestModel output)."""
+
     best_model = ComplexParam("best_model", "The winning model")
     scored_dataset = ComplexParam("scored_dataset", "Winner's scored eval dataset")
     all_model_metrics = ComplexParam("all_model_metrics", "Per-candidate metrics")

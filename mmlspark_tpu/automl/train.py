@@ -51,6 +51,8 @@ class _AutoTrainer(HasLabelCol, HasFeaturesCol):
 
 
 class TrainClassifier(Estimator, _AutoTrainer, Wrappable):
+    """Featurize + reindex labels + fit an inner classifier in one estimator (TrainClassifier.scala:53-207)."""
+
     reindex_label = Param("reindex_label", "Re-index labels to 0..K-1", TypeConverters.to_boolean)
 
     def __init__(self, model: Optional[Estimator] = None, label_col: str = "label",
@@ -99,6 +101,8 @@ class TrainClassifier(Estimator, _AutoTrainer, Wrappable):
 
 
 class TrainedClassifierModel(Model, HasLabelCol, Wrappable):
+    """Fitted TrainClassifier: featurize, score, and un-index predicted labels."""
+
     featurize_model = ComplexParam("featurize_model", "Fitted featurizer")
     inner_model = ComplexParam("inner_model", "Fitted inner model")
     levels = ComplexParam("levels", "Original label levels (index order)")
@@ -149,6 +153,8 @@ class TrainedClassifierModel(Model, HasLabelCol, Wrappable):
 
 
 class TrainRegressor(Estimator, _AutoTrainer, Wrappable):
+    """Featurize + fit an inner regressor in one estimator (TrainRegressor.scala)."""
+
     def __init__(self, model: Optional[Estimator] = None, label_col: str = "label",
                  number_of_features: int = 4096):
         super().__init__()
@@ -180,6 +186,8 @@ class TrainRegressor(Estimator, _AutoTrainer, Wrappable):
 
 
 class TrainedRegressorModel(Model, HasLabelCol, Wrappable):
+    """Fitted TrainRegressor: featurize and score."""
+
     featurize_model = ComplexParam("featurize_model", "Fitted featurizer")
     inner_model = ComplexParam("inner_model", "Fitted inner model")
     features_col_name = Param("features_col_name", "Assembled features column", TypeConverters.to_string)

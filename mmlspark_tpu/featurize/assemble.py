@@ -151,6 +151,8 @@ class Featurize(Estimator, HasOutputCol, Wrappable):
 
 
 class FeaturizeModel(Model, HasOutputCol, Wrappable):
+    """Fitted Featurize: applies per-column plans (cast/hash/one-hot/dates) and assembles the feature vector."""
+
     plans = ComplexParam("plans", "Per-column featurization plans")
 
     def __init__(self, plans: Optional[List[Dict[str, Any]]] = None):

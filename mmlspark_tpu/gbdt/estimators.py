@@ -348,6 +348,8 @@ class _BoosterModel(Model, HasFeaturesCol):
 
 
 class LightGBMClassificationModel(_BoosterModel, Wrappable):
+    """Fitted LightGBM-style classifier: raw margins, probabilities, and predicted labels (LightGBMClassifier.scala model)."""
+
     raw_prediction_col = Param("raw_prediction_col", "Raw margin column", TypeConverters.to_string)
     probability_col = Param("probability_col", "Probability vector column", TypeConverters.to_string)
 
@@ -389,6 +391,8 @@ class LightGBMClassificationModel(_BoosterModel, Wrappable):
 
 
 class LightGBMRegressionModel(_BoosterModel, Wrappable):
+    """Fitted LightGBM-style regressor (LightGBMRegressor.scala model)."""
+
     @staticmethod
     def load_native_model(path: str) -> "LightGBMRegressionModel":
         return LightGBMRegressionModel(Booster.load_native_model(path))

@@ -83,6 +83,8 @@ class LogisticRegression(Estimator, _LinearParams, Wrappable):
 
 
 class LogisticRegressionModel(Model, HasFeaturesCol, Wrappable):
+    """Fitted LogisticRegression: raw margins, probabilities, predictions."""
+
     inner = ComplexParam("inner", "Fitted TPUModel")
     prediction_col = Param("prediction_col", "Prediction column", TypeConverters.to_string)
     raw_prediction_col = Param("raw_prediction_col", "Raw margin column", TypeConverters.to_string)
@@ -119,6 +121,8 @@ class LogisticRegressionModel(Model, HasFeaturesCol, Wrappable):
 
 
 class LinearRegression(Estimator, _LinearParams, Wrappable):
+    """Linear regression trained with the jit DP loop (squared loss)."""
+
     def __init__(self, **kwargs: Any):
         super().__init__()
         self._set_linear_defaults()
@@ -138,6 +142,8 @@ class LinearRegression(Estimator, _LinearParams, Wrappable):
 
 
 class LinearRegressionModel(Model, HasFeaturesCol, Wrappable):
+    """Fitted LinearRegression: predictions from the inner TPUModel."""
+
     inner = ComplexParam("inner", "Fitted TPUModel")
     prediction_col = Param("prediction_col", "Prediction column", TypeConverters.to_string)
 

@@ -47,6 +47,8 @@ LOSSES = ("softmax_cross_entropy", "sigmoid_cross_entropy", "mse")
 
 
 class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
+    """In-process pjit DP(+TP) network trainer; the CNTKLearner role (CNTKLearner.scala) without the outer process."""
+
     network = ComplexParam("network", "The Network spec to train")
     loss = Param("loss", f"Loss function, one of {LOSSES}", TypeConverters.to_string)
     optimizer = Param(

@@ -24,6 +24,8 @@ class _RecColParams:
 
 
 class RecommendationIndexer(Estimator, _RecColParams, Wrappable):
+    """String user/item ids -> contiguous double indices (RecommendationIndexer.scala)."""
+
     def __init__(self, user_input_col: str = "user", user_output_col: str = "user_idx",
                  item_input_col: str = "item", item_output_col: str = "item_idx"):
         super().__init__()
@@ -48,6 +50,8 @@ class RecommendationIndexer(Estimator, _RecColParams, Wrappable):
 
 
 class RecommendationIndexerModel(Model, _RecColParams, Wrappable):
+    """Fitted indexer: transform ids to indices and recover them back."""
+
     user_levels = ComplexParam("user_levels", "Ordered user ids")
     item_levels = ComplexParam("item_levels", "Ordered item ids")
 

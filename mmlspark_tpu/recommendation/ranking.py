@@ -147,6 +147,8 @@ class RankingAdapter(Estimator, Wrappable):
 
 
 class RankingAdapterModel(Model, Wrappable):
+    """Fitted RankingAdapter: per-user top-k recommendations + ground-truth lists for ranking metrics."""
+
     recommender_model = ComplexParam("recommender_model", "Fitted recommender")
     user_col_name = Param("user_col_name", "User column", TypeConverters.to_string)
     item_col_name = Param("item_col_name", "Item column", TypeConverters.to_string)

@@ -29,6 +29,8 @@ from mmlspark_tpu.core.pipeline import Estimator, Model, PipelineStage, Transfor
 
 
 class DropColumns(Transformer, Wrappable):
+    """Drop the listed columns (Stages.scala DropColumns)."""
+
     cols = Param("cols", "Comma separated list of column names", TypeConverters.to_list_string)
 
     def __init__(self, cols: Optional[List[str]] = None):
@@ -48,6 +50,8 @@ class DropColumns(Transformer, Wrappable):
 
 
 class SelectColumns(Transformer, Wrappable):
+    """Keep only the listed columns (SelectColumns.scala)."""
+
     cols = Param("cols", "Comma separated list of selected column names", TypeConverters.to_list_string)
 
     def __init__(self, cols: Optional[List[str]] = None):
@@ -68,6 +72,8 @@ class SelectColumns(Transformer, Wrappable):
 
 
 class RenameColumn(Transformer, HasInputCol, HasOutputCol, Wrappable):
+    """Rename one column (Stages.scala RenameColumn)."""
+
     def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None):
         super().__init__()
         if input_col:
@@ -84,6 +90,8 @@ class RenameColumn(Transformer, HasInputCol, HasOutputCol, Wrappable):
 
 
 class Repartition(Transformer, Wrappable):
+    """Set the DataFrame's partition count metadata (Repartition.scala; single-process here)."""
+
     n = Param("n", "Number of partitions", TypeConverters.to_int)
     disable = Param("disable", "Pass through without repartitioning", TypeConverters.to_boolean)
 
@@ -187,6 +195,8 @@ class Timer(Estimator, Wrappable):
 
 
 class TimerModel(Model, Wrappable):
+    """Fitted Timer: logs wall-clock around the inner stage's transform."""
+
     stage = ComplexParam("stage", "The timed transformer")
 
     def __init__(self, stage: Optional[Transformer] = None):
@@ -292,6 +302,8 @@ class ClassBalancer(Estimator, HasInputCol, HasOutputCol, Wrappable):
 
 
 class ClassBalancerModel(Model, HasInputCol, HasOutputCol, Wrappable):
+    """Fitted ClassBalancer: adds the per-row weight column from the label-value weight table."""
+
     weights = ComplexParam("weights", "label value -> weight mapping")
 
     def __init__(self, weights: Optional[Dict[Any, float]] = None):

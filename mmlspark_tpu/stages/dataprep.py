@@ -84,6 +84,8 @@ class CleanMissingData(Estimator, HasInputCols, HasOutputCols, Wrappable):
 
 
 class CleanMissingDataModel(Model, HasInputCols, HasOutputCols, Wrappable):
+    """Fitted CleanMissingData: fills missing values with the learned per-column replacements."""
+
     fill_values = ComplexParam("fill_values", "column -> fill value")
 
     def __init__(self, fill_values: Optional[Dict[str, float]] = None):
@@ -129,6 +131,8 @@ class ValueIndexer(Estimator, HasInputCol, HasOutputCol, Wrappable):
 
 
 class ValueIndexerModel(Model, HasInputCol, HasOutputCol, Wrappable):
+    """Fitted ValueIndexer: maps values to ordinal indices with categorical metadata."""
+
     levels = ComplexParam("levels", "Ordered distinct level values")
 
     def __init__(self, levels: Optional[List[Any]] = None):

@@ -61,6 +61,8 @@ class Tokenizer(Transformer, HasInputCol, HasOutputCol, Wrappable):
 
 
 class RegexTokenizer(Transformer, HasInputCol, HasOutputCol, Wrappable):
+    """Regex-driven tokenizer (pattern matches separators or tokens)."""
+
     pattern = Param("pattern", "Regex (split pattern if gaps else match pattern)", TypeConverters.to_string)
     gaps = Param("gaps", "True: pattern matches gaps; False: matches tokens", TypeConverters.to_boolean)
     to_lowercase = Param("to_lowercase", "Lowercase first", TypeConverters.to_boolean)
@@ -96,6 +98,8 @@ class RegexTokenizer(Transformer, HasInputCol, HasOutputCol, Wrappable):
 
 
 class StopWordsRemover(Transformer, HasInputCol, HasOutputCol, Wrappable):
+    """Remove stop words from a token list column."""
+
     stop_words = Param("stop_words", "Words to filter out", TypeConverters.to_list_string)
     case_sensitive = Param("case_sensitive", "Case sensitive matching", TypeConverters.to_boolean)
 
@@ -126,6 +130,8 @@ class StopWordsRemover(Transformer, HasInputCol, HasOutputCol, Wrappable):
 
 
 class NGram(Transformer, HasInputCol, HasOutputCol, Wrappable):
+    """Token list -> n-gram string list."""
+
     n = Param("n", "N-gram length", TypeConverters.to_int)
 
     def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
@@ -186,6 +192,8 @@ class HashingTF(Transformer, HasInputCol, HasOutputCol, Wrappable):
 
 
 class IDF(Estimator, HasInputCol, HasOutputCol, Wrappable):
+    """Inverse document frequency estimator over term-frequency vectors (TextFeaturizer pipeline element)."""
+
     min_doc_freq = Param("min_doc_freq", "Zero out terms in fewer docs", TypeConverters.to_int)
 
     def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
@@ -213,6 +221,8 @@ class IDF(Estimator, HasInputCol, HasOutputCol, Wrappable):
 
 
 class IDFModel(Model, HasInputCol, HasOutputCol, Wrappable):
+    """Fitted IDF: scales term-frequency vectors by log((n+1)/(df+1)) weights."""
+
     idf = ComplexParam("idf", "Inverse document frequency vector")
 
     def __init__(self, idf: Optional[np.ndarray] = None):
@@ -310,6 +320,8 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol, Wrappable):
 
 
 class TextFeaturizerModel(Model, HasInputCol, HasOutputCol, Wrappable):
+    """Fitted TextFeaturizer: tokenize/filter/ngram/hash/IDF pipeline to feature vectors."""
+
     stages = ComplexParam("stages", "Fitted sub-stages")
 
     def __init__(self, stages: Optional[List[Transformer]] = None,

@@ -1,0 +1,33 @@
+"""Codegen gate: every stage and param documented; committed docs fresh.
+
+Reference analog: CodeGen.scala:44-98 runs at build time so the doc/wrapper
+surface can never drift from the code; here the test IS the build step."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools"))
+
+from codegen import DOCS_DIR, check_documented, generate  # noqa: E402
+
+
+def test_everything_documented():
+    problems = check_documented()
+    assert not problems, "\n".join(problems)
+
+
+def test_committed_docs_fresh():
+    pages = generate()
+    missing, stale = [], []
+    for fname, content in pages.items():
+        path = os.path.join(DOCS_DIR, fname)
+        if not os.path.exists(path):
+            missing.append(fname)
+        elif open(path).read() != content:
+            stale.append(fname)
+    on_disk = {f for f in os.listdir(DOCS_DIR) if f.endswith(".md")}
+    orphans = on_disk - set(pages)
+    assert not (missing or stale or orphans), (
+        f"docs/api out of date (missing={missing} stale={stale} "
+        f"orphans={sorted(orphans)}); rerun: python tools/codegen.py"
+    )
