@@ -39,10 +39,15 @@ CPU_LIGHTGBM_ADULT_SECONDS = 3.0  # documented estimate, see BASELINE.md
 
 N_IMAGES = 16384
 BATCH = 8192
-REPEATS = 3
+REPEATS = 5  # median-of-5 (round-3 verdict: best-of-3 hid tunnel variance)
 
 
-def bench_cifar() -> float:
+def bench_cifar():
+    """Returns (end_to_end imgs/sec, device_resident imgs/sec), both
+    median-of-REPEATS. The split separates what the chip does from what the
+    tunnel does, so a transfer regression can't masquerade as a compute one
+    (round-3 verdict item 5; anti-pattern: CNTKModel.scala:71-140 per-row
+    JNI eval)."""
     import jax
 
     from mmlspark_tpu.core.dataframe import DataFrame
@@ -67,14 +72,28 @@ def bench_cifar() -> float:
 
     model.transform(df.limit(BATCH))  # compile + warmup
 
-    best = 0.0
+    e2e = []
     for _ in range(REPEATS):
         t0 = time.time()
         out = model.transform(df)
-        dt = time.time() - t0
-        best = max(best, N_IMAGES / dt)
+        e2e.append(N_IMAGES / (time.time() - t0))
     assert out["scores"].shape == (N_IMAGES, 10)
-    return best
+
+    # device-resident: inputs pre-staged in HBM, outputs left on device —
+    # pure (MXU compute + dispatch) throughput
+    fn = model._compiled(str(net.spec), BATCH)
+    x_dev = [
+        jax.device_put(imgs[i : i + BATCH].reshape(-1, 32, 32, 3))
+        for i in range(0, N_IMAGES, BATCH)
+    ]
+    jax.block_until_ready(fn(variables, x_dev[0]))  # warm
+    resident = []
+    for _ in range(REPEATS):
+        t0 = time.time()
+        ys = [fn(variables, xd) for xd in x_dev]
+        jax.block_until_ready(ys)
+        resident.append(N_IMAGES / (time.time() - t0))
+    return float(np.median(e2e)), float(np.median(resident))
 
 
 def make_adult_like(n: int = 48842, seed: int = 0):
@@ -183,10 +202,121 @@ def bench_serving():
     return lat[len(lat) // 2] * 1000, lat[int(len(lat) * 0.99)] * 1000
 
 
+def bench_distributed_serving():
+    """Concurrent serving through the worker-pool gateway: 8 keep-alive
+    clients. Two paths, reported separately (round-3 verdict item 6):
+    - trivial handler (x -> 2x): protocol + routing floor
+    - ResNet-20 model path (batch-1 jit eval per request): the honest
+      model-in-the-loop number on this chip
+    """
+    import http.client
+    import threading
+
+    import jax
+
+    from mmlspark_tpu.core.dataframe import DataFrame, DataType
+    from mmlspark_tpu.dnn import resnet20_cifar
+    from mmlspark_tpu.dnn.network import NetworkBundle
+    from mmlspark_tpu.models import TPUModel
+    from mmlspark_tpu.serving import (
+        DistributedServingServer,
+        make_reply,
+        parse_request,
+    )
+
+    def run_load(srv, api, payload, n_clients=8, n_requests=40, warmup=4):
+        for _ in range(warmup):
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+            body = json.dumps(payload).encode()
+            conn.request("POST", f"/{api}", body,
+                         {"Content-Type": "application/json"})
+            conn.getresponse().read()
+            conn.close()
+        lat, errors, lock = [], [], threading.Lock()
+
+        def client():
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", srv.port, timeout=60
+                )
+                body = json.dumps(payload).encode()
+                for _ in range(n_requests):
+                    t0 = time.perf_counter()
+                    conn.request("POST", f"/{api}", body,
+                                 {"Content-Type": "application/json"})
+                    r = conn.getresponse()
+                    r.read()
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        if r.status != 200:
+                            errors.append(r.status)
+                        else:
+                            lat.append(dt)
+                conn.close()
+            except Exception as e:  # surface, don't die silently
+                with lock:
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=client) for _ in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors or not lat:
+            raise RuntimeError(f"serving load errors: {errors[:5]}")
+        lat = sorted(lat)
+        return lat[len(lat) // 2] * 1000, lat[int(len(lat) * 0.99)] * 1000
+
+    # trivial path
+    def trivial_factory():
+        def handler(df):
+            parsed = parse_request(df)
+            vals = np.asarray([float(v) for v in parsed["x"]])
+            return make_reply(
+                parsed.with_column("y", vals * 2.0, DataType.DOUBLE), "y"
+            )
+        return handler
+
+    with DistributedServingServer(
+        trivial_factory, n_workers=4, api_name="bench"
+    ) as srv:
+        triv_p50, triv_p99 = run_load(srv, "bench", {"x": 1.0})
+
+    # model path: ResNet-20 batch-1 per request
+    net = resnet20_cifar(num_classes=10, compute_dtype="bfloat16")
+    variables = net.init(jax.random.PRNGKey(0))
+    bundle = NetworkBundle(net, variables)
+
+    def model_factory():
+        model = TPUModel(bundle, input_col="img", output_col="scores",
+                         mini_batch_size=1)
+
+        def handler(df):
+            parsed = parse_request(df, {"img": DataType.VECTOR})
+            scored = model.transform(parsed)
+            out = scored.with_column(
+                "top", np.argmax(scored["scores"], axis=1).astype(np.float64),
+                DataType.DOUBLE,
+            )
+            return make_reply(out, "top")
+
+        return handler
+
+    img = np.zeros(32 * 32 * 3, np.float32).tolist()
+    with DistributedServingServer(
+        model_factory, n_workers=2, api_name="model"
+    ) as srv:
+        model_p50, model_p99 = run_load(
+            srv, "model", {"img": img}, n_requests=15
+        )
+    return triv_p50, triv_p99, model_p50, model_p99
+
+
 def main() -> int:
-    imgs_per_sec = bench_cifar()
+    imgs_per_sec, imgs_per_sec_resident = bench_cifar()
     gbdt_seconds, gbdt_auc = bench_gbdt()
     p50, p99 = bench_serving()
+    d_p50, d_p99, m_p50, m_p99 = bench_distributed_serving()
 
     print(
         json.dumps(
@@ -196,6 +326,9 @@ def main() -> int:
                 "unit": "imgs/sec/chip",
                 "vs_baseline": round(imgs_per_sec / V100_CNTK_IMGS_PER_SEC, 3),
                 "extras": {
+                    "cifar_device_resident_imgs_per_sec": round(
+                        imgs_per_sec_resident, 1
+                    ),
                     "gbdt_adult_fit_seconds": round(gbdt_seconds, 2),
                     "gbdt_adult_fit_vs_cpu_baseline": round(
                         CPU_LIGHTGBM_ADULT_SECONDS / gbdt_seconds, 3
@@ -203,6 +336,10 @@ def main() -> int:
                     "gbdt_adult_auc": round(gbdt_auc, 4),
                     "serving_p50_ms": round(p50, 3),
                     "serving_p99_ms": round(p99, 3),
+                    "serving_pool8_p50_ms": round(d_p50, 3),
+                    "serving_pool8_p99_ms": round(d_p99, 3),
+                    "serving_resnet20_p50_ms": round(m_p50, 3),
+                    "serving_resnet20_p99_ms": round(m_p99, 3),
                 },
             }
         )

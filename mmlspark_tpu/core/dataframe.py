@@ -267,6 +267,19 @@ class DataFrame:
     def metadata(self, name: str) -> dict:
         return self.column(name).metadata
 
+    # -- fluent ML sugar (reference FluentAPI.scala:14-20) --------------------
+
+    def ml_transform(self, *stages) -> "DataFrame":
+        """df.ml_transform(t1, t2, ...) — apply transformers in order."""
+        out = self
+        for stage in stages:
+            out = stage.transform(out)
+        return out
+
+    def ml_fit(self, estimator):
+        """df.ml_fit(est) — fit an estimator on this frame, return the model."""
+        return estimator.fit(self)
+
     # -- projection / mutation (returns new DataFrame) ------------------------
 
     def select(self, *names: str) -> "DataFrame":

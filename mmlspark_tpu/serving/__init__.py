@@ -22,5 +22,12 @@ from mmlspark_tpu.serving.server import (
     parse_request,
     serve_pipeline,
 )
+from mmlspark_tpu.serving.distributed import DistributedServingServer
 
-__all__ = ["ServingServer", "make_reply", "parse_request", "serve_pipeline"]
+__all__ = [
+    "DistributedServingServer",
+    "ServingServer",
+    "make_reply",
+    "parse_request",
+    "serve_pipeline",
+]

@@ -1,0 +1,193 @@
+"""Distributed serving: a worker pool behind a routing gateway.
+
+Reference: io/http/src/main/scala/DistributedHTTPSource.scala:89-242 — one
+JVMSharedServer per executor, each binding its own port and scoring its own
+partition, with a driver-side gateway (PortForwarding.scala:12) fronting the
+pool — and HTTPSourceV2.scala:167-404's continuous per-partition commit (no
+cross-partition lock).
+
+TPU re-design: the partition==executor mapping becomes worker==replica. Each
+worker owns a PRIVATE handler instance (its own compiled model, its own
+model lock), so continuous-mode scoring never serializes across workers —
+the exact fix for the single `_model_lock` bottleneck flagged in round 3.
+Workers are in-process threads sharing the chip: XLA executes their
+dispatches back-to-back, so concurrency hides host-side overhead (request
+parse, feature build, reply encode) behind device compute. Multi-host scale
+uses the same topology with workers on peer hosts and the router as the
+cross-host gateway.
+"""
+
+from __future__ import annotations
+
+import http.client
+import http.server
+import itertools
+import socket
+import threading
+from typing import Callable, List, Optional
+
+from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.serving.server import ServingServer
+
+log = get_logger("mmlspark_tpu.serving")
+
+
+class DistributedServingServer:
+    """N ServingServer workers + a routing gateway on one public port.
+
+    handler_factory() is called once PER WORKER so each worker holds its own
+    handler state (compiled model replica, locks). Pass a plain handler only
+    if it is stateless/thread-safe.
+    """
+
+    def __init__(
+        self,
+        handler_factory: Callable[[], Callable[[DataFrame], DataFrame]],
+        n_workers: int = 2,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        api_name: str = "serving",
+        mode: str = "continuous",
+        max_batch_size: int = 64,
+        max_wait_ms: float = 5.0,
+        request_timeout: float = 30.0,
+    ):
+        if n_workers < 1:
+            raise ValueError("n_workers must be >= 1")
+        self.host = host
+        self.api_name = api_name
+        self._port = port
+        self.workers: List[ServingServer] = [
+            ServingServer(
+                handler_factory(),
+                host=host,
+                port=0,
+                api_name=api_name,
+                mode=mode,
+                max_batch_size=max_batch_size,
+                max_wait_ms=max_wait_ms,
+                request_timeout=request_timeout,
+            )
+            for _ in range(n_workers)
+        ]
+        self._rr = itertools.count()
+        self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+        # keep-alive connections to workers, one per (gateway thread, worker)
+        self._local = threading.local()
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self._port}/{self.api_name}"
+
+    # -- gateway ---------------------------------------------------------------
+
+    def _worker_conn(self, idx: int) -> http.client.HTTPConnection:
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            conns = self._local.conns = {}
+        conn = conns.get(idx)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self.workers[idx].host, self.workers[idx].port
+            )
+            conn.connect()
+            # small writes both ways: Nagle + delayed ACK would add ~40 ms
+            # per forwarded exchange (same fix as ServingServer's handler)
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conns[idx] = conn
+        return conn
+
+    def _forward(self, idx: int, method: str, path: str, body: bytes,
+                 content_type: str):
+        conn = self._worker_conn(idx)
+        headers = {"Content-Type": content_type or "application/json"}
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            return conn.getresponse()
+        except (http.client.HTTPException, ConnectionError, OSError):
+            # stale keep-alive: rebuild once and retry
+            conn.close()
+            self._local.conns.pop(idx, None)
+            conn = self._worker_conn(idx)
+            conn.request(method, path, body=body, headers=headers)
+            return conn.getresponse()
+
+    def start(self) -> "DistributedServingServer":
+        for w in self.workers:
+            w.start()
+        outer = self
+
+        class Gateway(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
+            def log_message(self, fmt, *args):
+                log.debug("gateway %s " + fmt, self.address_string(), *args)
+
+            def do_POST(self):
+                route = self.path.split("?", 1)[0].rstrip("/")
+                if route != f"/{outer.api_name}":
+                    self.send_response(404, "Not Found")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                idx = next(outer._rr) % len(outer.workers)
+                try:
+                    resp = outer._forward(
+                        idx, self.command, self.path, body,
+                        self.headers.get("Content-Type"),
+                    )
+                    payload = resp.read()
+                except Exception as e:  # dead worker: surface a 502
+                    log.warning("worker %d unreachable: %r", idx, e)
+                    msg = b'{"error": "bad gateway: worker unreachable"}'
+                    self.send_response(502, "Bad Gateway")
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(msg)))
+                    self.end_headers()
+                    self.wfile.write(msg)
+                    return
+                self.send_response(resp.status, resp.reason)
+                ct = resp.getheader("Content-Type")
+                if ct:
+                    self.send_header("Content-Type", ct)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST
+            do_PUT = do_POST
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.host, self._port), Gateway
+        )
+        self._httpd.daemon_threads = True
+        self._port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        log.info(
+            "distributed serving %s -> %d workers (%s)",
+            self.url, len(self.workers),
+            ", ".join(str(w.port) for w in self.workers),
+        )
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        for w in self.workers:
+            w.stop()
+
+    def __enter__(self) -> "DistributedServingServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,30 @@
+"""Small column helpers exposed as udf-style callables.
+
+Reference: udf/src/main/scala/udfs.scala:15 (get_value_at over vector
+columns) and the udf package's registration pattern. Here they are plain
+callables usable directly, with UDFTransformer, or via DataFrame.ml_transform.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+
+def get_value_at(i: int) -> Callable[[Any], float]:
+    """Per-row accessor: vector value -> its i-th element
+    (udfs.scala:15 get_value_at)."""
+
+    def _get(v: Any) -> float:
+        return float(np.asarray(v).reshape(-1)[i])
+
+    return _get
+
+
+def get_value_at_column(values: np.ndarray, i: int) -> np.ndarray:
+    """Whole-column vectorized version: (n, d) vector column -> (n,) floats."""
+    arr = np.asarray(values)
+    if arr.dtype == object:
+        return np.array([float(np.asarray(v).reshape(-1)[i]) for v in arr])
+    return arr[:, i].astype(np.float64)

@@ -1,0 +1,156 @@
+"""Tests: FluentAPI sugar, udfs, PowerBI sink, cognitive-style clients."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.io import powerbi
+from mmlspark_tpu.io.cognitive import AnomalyDetector, TextSentiment
+from mmlspark_tpu.stages.basic import UDFTransformer
+from mmlspark_tpu.stages.udfs import get_value_at, get_value_at_column
+
+
+class TestFluentAPI:
+    def test_ml_transform_chains(self):
+        from mmlspark_tpu.stages.basic import DropColumns, RenameColumn
+
+        df = DataFrame.from_dict({"a": [1.0, 2.0], "b": [3.0, 4.0]})
+        out = df.ml_transform(
+            RenameColumn(input_col="a", output_col="a2"),
+            DropColumns(cols=["b"]),
+        )
+        assert out.columns == ["a2"]
+
+    def test_ml_fit(self):
+        from mmlspark_tpu.stages.basic import ClassBalancer
+
+        df = DataFrame.from_dict({"label": np.array([0.0, 0.0, 1.0])})
+        model = df.ml_fit(ClassBalancer(input_col="label"))
+        assert model.transform(df)["weight"][2] == 2.0
+
+
+class TestUdfs:
+    def test_get_value_at(self):
+        df = DataFrame.from_dict({"v": np.arange(12.0).reshape(4, 3)})
+        stage = UDFTransformer(input_col="v", output_col="second",
+                               udf=get_value_at(1))
+        out = stage.transform(df)
+        np.testing.assert_allclose(out["second"], [1.0, 4.0, 7.0, 10.0])
+
+    def test_get_value_at_column(self):
+        vals = np.arange(6.0).reshape(3, 2)
+        np.testing.assert_allclose(get_value_at_column(vals, 0), [0, 2, 4])
+
+
+def _start_capture_server(status=200, body=b"{}"):
+    """Tiny HTTP server that records JSON request bodies."""
+    import http.server
+
+    captured = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            captured.append(
+                (self.path, dict(self.headers), self.rfile.read(n))
+            )
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, captured
+
+
+class TestPowerBI:
+    def test_write_batches(self):
+        httpd, captured = _start_capture_server()
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}/push"
+            df = DataFrame.from_dict(
+                {"name": np.array(list("abcde"), object), "x": np.arange(5.0)},
+                types={"name": DataType.STRING},
+            )
+            sent = powerbi.write(df, url, {"batchSize": 2})
+            assert sent == 3  # 2+2+1
+            rows = [r for _, _, b in captured for r in json.loads(b)]
+            assert len(rows) == 5
+            assert {"name": "a", "x": 0.0} in rows
+        finally:
+            httpd.shutdown()
+
+    def test_http_error_raises(self):
+        httpd, _ = _start_capture_server(status=503)
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}/push"
+            df = DataFrame.from_dict({"x": np.arange(3.0)})
+            with pytest.raises(RuntimeError, match="HTTP 503"):
+                powerbi.write(df, url, {"batchSize": 3})
+        finally:
+            httpd.shutdown()
+
+    def test_rejects_unknown_option(self):
+        df = DataFrame.from_dict({"x": np.arange(2.0)})
+        with pytest.raises(ValueError, match="not applicable"):
+            powerbi.write(df, "http://x", {"bogus": "1"})
+
+
+class TestCognitive:
+    def test_text_sentiment_contract(self):
+        httpd, captured = _start_capture_server(
+            body=json.dumps(
+                {"documents": [{"id": "1", "score": 0.9}], "errors": []}
+            ).encode()
+        )
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}/sentiment"
+            df = DataFrame.from_dict(
+                {"text": np.array(["great product", "terrible"], object)},
+                types={"text": DataType.STRING},
+            )
+            ts = TextSentiment(
+                url=url, subscription_key="secret-key",
+                input_col="text", output_col="sentiment",
+            )
+            out = ts.transform(df)
+            assert "sentiment" in out.columns
+            got = out["sentiment"][0]
+            assert got["documents"][0]["score"] == 0.9
+            # request contract: documents JSON + key header
+            path, headers, body = captured[0]
+            sent = json.loads(body)
+            assert sent["documents"][0]["text"] == "great product"
+            assert sent["documents"][0]["language"] == "en"
+            assert headers.get("Ocp-Apim-Subscription-Key") == "secret-key"
+        finally:
+            httpd.shutdown()
+
+    def test_anomaly_detector_body(self):
+        httpd, captured = _start_capture_server(
+            body=json.dumps({"isAnomaly": [False, True]}).encode()
+        )
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}/anomaly"
+            series = np.empty(1, object)
+            series[0] = [
+                {"timestamp": "2026-01-01T00:00:00Z", "value": 1.0},
+                {"timestamp": "2026-01-02T00:00:00Z", "value": 99.0},
+            ]
+            df = DataFrame.from_dict({"series": series})
+            ad = AnomalyDetector(url=url, input_col="series", output_col="verdict")
+            out = ad.transform(df)
+            assert out["verdict"][0]["isAnomaly"] == [False, True]
+            sent = json.loads(captured[0][2])
+            assert sent["granularity"] == "daily"
+            assert len(sent["series"]) == 2
+        finally:
+            httpd.shutdown()
