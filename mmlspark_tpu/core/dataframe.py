@@ -403,31 +403,38 @@ class DataFrame:
         return GroupedData(self, list(keys))
 
     def join(self, other: "DataFrame", on: Union[str, List[str]], how: str = "inner") -> "DataFrame":
+        """Vectorized hash/sort join (np.unique + searchsorted) — no per-row
+        Python on the hot path, so reference-scale frames (millions of rows
+        feeding SAR/stats) join at array speed. Emits inner pairs in left-row
+        order (right matches in right order within a key), unmatched-left
+        rows inline, unmatched-right appended — the same layout the previous
+        dict-index implementation produced."""
         on_cols = [on] if isinstance(on, str) else list(on)
-        left_keys = list(zip(*(self._hashable_col(k) for k in on_cols)))
-        right_keys = list(zip(*(other._hashable_col(k) for k in on_cols)))
-        right_index: Dict[Any, List[int]] = {}
-        for i, k in enumerate(right_keys):
-            right_index.setdefault(k, []).append(i)
-        li, ri = [], []
-        matched_right: set = set()
-        for i, k in enumerate(left_keys):
-            hits = right_index.get(k)
-            if hits:
-                for j in hits:
-                    li.append(i)
-                    ri.append(j)
-                    matched_right.add(j)
-            elif how in ("left", "left_outer", "outer", "full"):
-                li.append(i)
-                ri.append(-1)
-        if how in ("right", "right_outer", "outer", "full"):
-            for j in range(len(right_keys)):
-                if j not in matched_right:
-                    li.append(-1)
-                    ri.append(j)
-        li_arr = np.asarray(li, dtype=np.int64)
-        ri_arr = np.asarray(ri, dtype=np.int64)
+        nl, nr = len(self), len(other)
+        lk, rk = _join_codes(self, other, on_cols)
+
+        order = np.argsort(rk, kind="stable")
+        rks = rk[order]
+        lo = np.searchsorted(rks, lk, "left")
+        hi = np.searchsorted(rks, lk, "right")
+        cnt = hi - lo
+        matched = cnt > 0
+        left_keep = how in ("left", "left_outer", "outer", "full")
+        cnt2 = np.where(matched, cnt, 1 if left_keep else 0)
+        total = int(cnt2.sum())
+        li_arr = np.repeat(np.arange(nl, dtype=np.int64), cnt2)
+        # per-slot offsets within each left row's match group
+        grp_pos = np.cumsum(cnt2) - cnt2
+        off = np.arange(total, dtype=np.int64) - np.repeat(grp_pos, cnt2)
+        ri_arr = np.full(total, -1, dtype=np.int64)
+        fill = np.repeat(matched, cnt2)
+        ri_arr[fill] = order[(np.repeat(lo, cnt2) + off)[fill]]
+        if how in ("right", "right_outer", "outer", "full") and nr:
+            mr = np.zeros(nr, bool)
+            mr[ri_arr[ri_arr >= 0]] = True
+            extra = np.nonzero(~mr)[0]
+            li_arr = np.concatenate([li_arr, np.full(len(extra), -1, np.int64)])
+            ri_arr = np.concatenate([ri_arr, extra.astype(np.int64)])
         cols: Dict[str, Column] = {}
         for n, c in self._columns.items():
             cols[n] = _gather_with_null(c, li_arr)
@@ -556,8 +563,80 @@ def _gather_with_null(col: Column, idx: np.ndarray) -> Column:
     return Column(vals, col.dtype, dict(col.metadata))
 
 
+def _factorize(vals: np.ndarray) -> np.ndarray:
+    """(n,) or (n, d) values -> (n,) int64 codes; equal values (rows for
+    2-D / VECTOR columns) share a code."""
+    arr = np.asarray(vals)
+    if arr.dtype != object and arr.dtype.kind in "biufSUM":
+        if arr.ndim == 2:  # VECTOR column: factorize whole rows
+            _, inv = np.unique(arr, axis=0, return_inverse=True)
+        else:
+            _, inv = np.unique(arr, return_inverse=True)
+        return inv.astype(np.int64).reshape(-1)
+    codes = np.empty(len(arr), np.int64)
+    lookup: Dict[Any, int] = {}
+    for i, v in enumerate(arr):
+        if isinstance(v, np.ndarray):  # unhashable cell
+            v = tuple(v.tolist())
+        codes[i] = lookup.setdefault(v, len(lookup))
+    return codes
+
+
+def _multi_codes(cols: List[np.ndarray]) -> np.ndarray:
+    """Combine per-column codes into one int64 code (mixed radix). Codes
+    re-compress (np.unique) whenever the running radix product would
+    overflow int64 — silent wraparound would alias distinct keys."""
+    combined = cols[0].astype(np.int64)
+    cmax = int(combined.max()) + 1 if len(combined) else 1
+    for c in cols[1:]:
+        radix = int(c.max()) + 1 if len(c) else 1
+        if cmax > (2 ** 62) // max(radix, 1):
+            _, inv = np.unique(combined, return_inverse=True)
+            combined = inv.astype(np.int64)
+            cmax = int(combined.max()) + 1 if len(combined) else 1
+        combined = combined * radix + c
+        cmax = cmax * radix
+    return combined
+
+
+def _kind_class(arr: np.ndarray) -> str:
+    if arr.dtype == object:
+        return "object"
+    return {"b": "num", "i": "num", "u": "num", "f": "num",
+            "S": "str", "U": "str", "M": "time"}.get(arr.dtype.kind, "object")
+
+
+def _join_codes(
+    left: "DataFrame", right: "DataFrame", on_cols: List[str]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Shared-code-space factorization of the join keys of both frames.
+    Mismatched key families (numeric vs string) go through the object path
+    so numpy's implicit int->str stringification can't fabricate matches;
+    there, int 1 and str '1' stay distinct dict keys (zero matches, the
+    pre-vectorization behavior). Numeric int/float promotion is kept —
+    hash(1) == hash(1.0) matched in the old dict index too."""
+    nl = len(left)
+    per_col = []
+    for k in on_cols:
+        lv, rv = left[k], right[k]
+        same_family = _kind_class(lv) == _kind_class(rv) != "object"
+        if same_family and lv.ndim == rv.ndim:
+            both = np.concatenate([lv, rv])
+        else:
+            both = np.concatenate(
+                [np.asarray(lv, dtype=object), np.asarray(rv, dtype=object)]
+            )
+        per_col.append(_factorize(both))
+    codes = _multi_codes(per_col)
+    return codes[:nl], codes[nl:]
+
+
 class GroupedData:
-    """Minimal groupBy support: agg with named aggregations, and apply()."""
+    """Minimal groupBy support: agg with named aggregations, and apply().
+
+    Group discovery is vectorized (factorize -> stable argsort -> split), so
+    reference-scale frames group at array speed; only `apply` and
+    `collect_list` materialize per-group Python objects."""
 
     _AGGS = {
         "sum": np.sum,
@@ -573,10 +652,31 @@ class GroupedData:
     def __init__(self, df: DataFrame, keys: List[str]):
         self.df = df
         self.keys = keys
-        self._groups: Dict[Any, List[int]] = {}
-        key_cols = [df._hashable_col(k) for k in keys]
-        for i, key in enumerate(zip(*key_cols)):
-            self._groups.setdefault(key, []).append(i)
+        n = len(df)
+        self._groups: Dict[Any, np.ndarray] = {}
+        if n == 0:
+            return
+        codes = _multi_codes([_factorize(df[k]) for k in keys])
+        order = np.argsort(codes, kind="stable")
+        sorted_codes = codes[order]
+        # boundaries of equal-code runs -> per-group row-index arrays
+        starts = np.nonzero(np.r_[True, sorted_codes[1:] != sorted_codes[:-1]])[0]
+        groups = np.split(order, starts[1:])
+        # first-appearance order (the old dict preserved insertion order)
+        groups.sort(key=lambda g: int(g[0]))
+        key_arrays = [df[k] for k in keys]
+
+        def cell(a, i):
+            v = a[i]
+            if isinstance(v, np.generic):
+                return v.item()
+            if isinstance(v, np.ndarray):  # VECTOR key row
+                return tuple(v.tolist())
+            return v
+
+        for g in groups:
+            i0 = int(g[0])
+            self._groups[tuple(cell(a, i0) for a in key_arrays)] = g
 
     def agg(self, **named_aggs: Tuple[str, str]) -> DataFrame:
         """agg(total=("amount","sum"), n=("amount","count"))"""

@@ -25,6 +25,10 @@ from mmlspark_tpu.core.pipeline import Estimator, Model
 SIMILARITY_FUNCTIONS = ("jaccard", "lift", "cooccurrence")
 
 
+def _is_sparse(m) -> bool:
+    return hasattr(m, "tocsr") and hasattr(m, "nnz")
+
+
 @functools.partial(__import__("jax").jit, static_argnames=())
 def _cooccurrence(b):
     return b.T @ b
@@ -65,6 +69,9 @@ class SAR(Estimator, Wrappable):
         "activity_time_format", "strptime format for string time columns",
         TypeConverters.to_string,
     )
+
+    # past this many user x item cells, fit builds sparse matrices
+    _DENSE_LIMIT = 50_000_000
 
     def __init__(self, user_col: str = "user_idx", item_col: str = "item_idx",
                  rating_col: str = "rating", time_col: Optional[str] = None,
@@ -147,12 +154,32 @@ class SAR(Estimator, Wrappable):
         else:
             decay = np.ones(len(df))
 
-        affinity = np.zeros((n_users, n_items), np.float32)
-        np.add.at(affinity, (users, items), ratings * decay)
+        # Dense user x item matrices ride the MXU; past _DENSE_LIMIT cells
+        # (4 GB-class at 100k users x 10k items) both matrices go
+        # scipy.sparse — the reference's SAR is built from co-occurrence
+        # aggregations for exactly this reason, and events are sparse.
+        sparse_mode = n_users * n_items > self._DENSE_LIMIT
+        if sparse_mode:
+            import scipy.sparse as sp
 
-        occurrence = np.zeros((n_users, n_items), np.float32)
-        occurrence[users, items] = 1.0
-        c = np.asarray(_cooccurrence(jax.device_put(occurrence)), np.float64)
+            affinity = sp.coo_matrix(
+                ((ratings * decay).astype(np.float32), (users, items)),
+                shape=(n_users, n_items),
+            ).tocsr()  # coo->csr sums duplicate (user, item) entries
+            occ = sp.coo_matrix(
+                (np.ones(len(users), np.float32), (users, items)),
+                shape=(n_users, n_items),
+            ).tocsr()
+            occ.data[:] = 1.0  # binary occurrence, duplicates collapsed
+            occurrence = occ
+            c = np.asarray((occ.T @ occ).todense(), np.float64)
+        else:
+            affinity = np.zeros((n_users, n_items), np.float32)
+            np.add.at(affinity, (users, items), ratings * decay)
+
+            occurrence = np.zeros((n_users, n_items), np.float32)
+            occurrence[users, items] = 1.0
+            c = np.asarray(_cooccurrence(jax.device_put(occurrence)), np.float64)
 
         thr = float(self.get(self.support_threshold))
         c = np.where(c >= thr, c, 0.0)
@@ -168,7 +195,8 @@ class SAR(Estimator, Wrappable):
         sim = np.nan_to_num(sim, nan=0.0, posinf=0.0, neginf=0.0)
 
         model = SARModel(
-            sim.astype(np.float32), affinity, occurrence.astype(bool)
+            sim.astype(np.float32), affinity,
+            occurrence.astype(bool),
         )
         for p in ("user_col", "item_col", "rating_col"):
             model.set(p, self.get(p))
@@ -193,12 +221,15 @@ class SARModel(Model, Wrappable):
                  seen: Optional[np.ndarray] = None):
         super().__init__()
         self._set_defaults(user_col="user_idx", item_col="item_idx", rating_col="rating")
+        def _keep(m):  # scipy sparse passes through; everything else densifies
+            return m if _is_sparse(m) else np.asarray(m)
+
         if item_similarity is not None:
             self.set(self.item_similarity, np.asarray(item_similarity))
         if user_affinity is not None:
-            self.set(self.user_affinity, np.asarray(user_affinity))
+            self.set(self.user_affinity, _keep(user_affinity))
         if seen is not None:
-            self.set(self.seen, np.asarray(seen))
+            self.set(self.seen, _keep(seen))
 
     def get_item_similarity(self) -> np.ndarray:
         return self.get(self.item_similarity)
@@ -206,44 +237,79 @@ class SARModel(Model, Wrappable):
     def get_user_affinity(self) -> np.ndarray:
         return self.get(self.user_affinity)
 
+    _BLOCK = 4096  # users scored per block in the sparse path
+
     def _scores(self) -> np.ndarray:
+        """Full dense (n_users, n_items) score matrix. For sparse models
+        prefer _score_block / recommend_for_all_users, which never
+        materialize more than _BLOCK rows at once."""
+        aff = self.get(self.user_affinity)
+        if _is_sparse(aff):
+            return np.asarray(
+                (aff @ self.get(self.item_similarity)), np.float32
+            )
         import jax
 
         return np.asarray(
             _score(
-                jax.device_put(self.get(self.user_affinity).astype(np.float32)),
+                jax.device_put(aff.astype(np.float32)),
                 jax.device_put(self.get(self.item_similarity).astype(np.float32)),
             )
         )
 
+    def _score_block(self, user_idx: np.ndarray) -> np.ndarray:
+        """(len(user_idx), n_items) scores for a block of users."""
+        aff = self.get(self.user_affinity)
+        sim = self.get(self.item_similarity)
+        if _is_sparse(aff):
+            return np.asarray(aff[user_idx] @ sim, np.float32)
+        return aff[user_idx].astype(np.float32) @ sim
+
     def transform(self, df: DataFrame) -> DataFrame:
         """Score each (user, item) row: affinity-weighted similarity."""
-        scores = self._scores()
+        aff = self.get(self.user_affinity)
+        n_users, n_items = aff.shape
         users = df[self.get(self.user_col)].astype(np.int64)
         items = df[self.get(self.item_col)].astype(np.int64)
-        n_users, n_items = scores.shape
         pred = np.zeros(len(df), np.float64)
         ok = (users < n_users) & (items < n_items) & (users >= 0) & (items >= 0)
-        pred[ok] = scores[users[ok], items[ok]]
+        uniq, inv = np.unique(users[ok], return_inverse=True)
+        ok_rows = np.nonzero(ok)[0]
+        ok_items = items[ok]
+        # block over the distinct users actually referenced; only _BLOCK
+        # scored rows live at a time (the point of the sparse path)
+        for s in range(0, len(uniq), self._BLOCK):
+            blk = uniq[s : s + self._BLOCK]
+            scored = self._score_block(blk)
+            in_blk = (inv >= s) & (inv < s + len(blk))
+            pred[ok_rows[in_blk]] = scored[inv[in_blk] - s, ok_items[in_blk]]
         return df.with_column("prediction", pred, DataType.DOUBLE)
 
     def recommend_for_all_users(self, num_items: int = 10,
                                 remove_seen: bool = True) -> DataFrame:
         """-> DataFrame(user, recommendations: [item ids], ratings: [scores])
-        (reference: SARModel.recommendForAllUsers)."""
-        scores = self._scores().copy()
-        if remove_seen:
-            scores[self.get(self.seen)] = -np.inf
-        k = min(num_items, scores.shape[1])
-        top = np.argsort(-scores, axis=1)[:, :k]
-        top_scores = np.take_along_axis(scores, top, axis=1)
-        n_users = scores.shape[0]
+        (reference: SARModel.recommendForAllUsers). Blocked: peak memory is
+        O(_BLOCK x n_items) regardless of user count."""
+        aff = self.get(self.user_affinity)
+        seen = self.get(self.seen)
+        n_users, n_items = aff.shape
+        k = min(num_items, n_items)
         recs = np.empty(n_users, dtype=object)
         vals = np.empty(n_users, dtype=object)
-        for u in range(n_users):
-            keep = np.isfinite(top_scores[u])
-            recs[u] = [int(i) for i in top[u][keep]]
-            vals[u] = [float(s) for s in top_scores[u][keep]]
+        for s in range(0, n_users, self._BLOCK):
+            idx = np.arange(s, min(s + self._BLOCK, n_users))
+            scores = self._score_block(idx).astype(np.float64)
+            if remove_seen:
+                blk_seen = seen[idx]
+                if _is_sparse(blk_seen):
+                    blk_seen = np.asarray(blk_seen.todense())
+                scores[np.asarray(blk_seen, bool)] = -np.inf
+            top = np.argsort(-scores, axis=1)[:, :k]
+            top_scores = np.take_along_axis(scores, top, axis=1)
+            for r, u in enumerate(idx):
+                keep = np.isfinite(top_scores[r])
+                recs[u] = [int(i) for i in top[r][keep]]
+                vals[u] = [float(x) for x in top_scores[r][keep]]
         return DataFrame(
             {
                 self.get(self.user_col): Column(

@@ -1,0 +1,147 @@
+"""Data-plane scale smoke: the relational ops and SAR must carry
+reference-scale workloads (round-3 verdict item 7 — millions of rows feeding
+SAR/stats were previously pure-Python loops)."""
+
+import time
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.recommendation.sar import SAR, _is_sparse
+
+N = 1_000_000
+
+
+def test_join_1m_rows():
+    rng = np.random.default_rng(0)
+    left = DataFrame.from_dict(
+        {"k": rng.integers(0, 200_000, N).astype(np.int64), "a": rng.normal(size=N)}
+    )
+    right = DataFrame.from_dict(
+        {
+            "k": np.arange(200_000, dtype=np.int64),
+            "b": np.arange(200_000, dtype=np.float64),
+        }
+    )
+    t0 = time.perf_counter()
+    out = left.join(right, on="k", how="inner")
+    dt = time.perf_counter() - t0
+    assert len(out) == N
+    np.testing.assert_allclose(out["b"], out["k"].astype(np.float64))
+    # vectorized path is ~1s; the old dict loop took tens of seconds
+    assert dt < 20, f"join too slow: {dt:.1f}s"
+
+
+def test_group_by_1m_rows():
+    rng = np.random.default_rng(1)
+    df = DataFrame.from_dict(
+        {
+            "k": rng.integers(0, 50_000, N).astype(np.int64),
+            "v": np.ones(N, np.float64),
+        }
+    )
+    t0 = time.perf_counter()
+    agg = df.group_by("k").agg(total=("v", "sum"))
+    dt = time.perf_counter() - t0
+    assert len(agg) == 50_000
+    np.testing.assert_allclose(np.sort(agg["k"]), np.arange(50_000))
+    assert agg["total"].sum() == N
+    assert dt < 30, f"group_by too slow: {dt:.1f}s"
+
+
+def test_join_semantics_match_small():
+    """Vectorized join must reproduce the documented layout on a case with
+    duplicates, misses on both sides, and multi-key."""
+    left = DataFrame.from_dict(
+        {
+            "k": np.array([1, 2, 2, 3, 5], np.int64),
+            "g": np.array(["x", "x", "y", "x", "x"], object),
+            "a": np.arange(5.0),
+        },
+        types={"g": DataType.STRING},
+    )
+    right = DataFrame.from_dict(
+        {
+            "k": np.array([2, 2, 3, 4], np.int64),
+            "g": np.array(["x", "x", "x", "x"], object),
+            "b": np.arange(4.0) * 10,
+        },
+        types={"g": DataType.STRING},
+    )
+    inner = left.join(right, on=["k", "g"], how="inner")
+    # left row 1 (k=2,g=x) matches right rows 0,1; left row 3 (k=3,g=x)
+    # matches right row 2
+    np.testing.assert_array_equal(inner["a"], [1.0, 1.0, 3.0])
+    np.testing.assert_array_equal(inner["b"], [0.0, 10.0, 20.0])
+
+    louter = left.join(right, on=["k", "g"], how="left")
+    assert len(louter) == 6  # 3 matches + 3 unmatched left rows inline
+    np.testing.assert_array_equal(louter["a"], [0.0, 1.0, 1.0, 2.0, 3.0, 4.0])
+
+    full = left.join(right, on=["k", "g"], how="outer")
+    assert len(full) == 7  # + unmatched right row (k=4)
+    assert full["k"][-1] == 4
+
+
+def test_sar_sparse_mode_matches_dense():
+    """Above _DENSE_LIMIT SAR goes sparse; results must match the dense
+    path exactly."""
+    rng = np.random.default_rng(2)
+    n_events = 5000
+    df = DataFrame.from_dict(
+        {
+            "user_idx": rng.integers(0, 300, n_events).astype(np.float64),
+            "item_idx": rng.integers(0, 40, n_events).astype(np.float64),
+            "rating": rng.integers(1, 5, n_events).astype(np.float64),
+        }
+    )
+    dense_model = SAR(support_threshold=1).fit(df)
+
+    old = SAR._DENSE_LIMIT
+    SAR._DENSE_LIMIT = 1  # force sparse
+    try:
+        sparse_model = SAR(support_threshold=1).fit(df)
+    finally:
+        SAR._DENSE_LIMIT = old
+
+    assert _is_sparse(sparse_model.get(sparse_model.user_affinity))
+    np.testing.assert_allclose(
+        dense_model.get_item_similarity(),
+        sparse_model.get_item_similarity(),
+        rtol=1e-6, atol=1e-6,
+    )
+    scores_d = dense_model.transform(df)["prediction"]
+    scores_s = sparse_model.transform(df)["prediction"]
+    np.testing.assert_allclose(scores_d, scores_s, rtol=1e-4, atol=1e-4)
+
+    rd = dense_model.recommend_for_all_users(5)
+    rs = sparse_model.recommend_for_all_users(5)
+    assert list(rd["recommendations"][0]) == list(rs["recommendations"][0])
+
+
+def test_sar_100k_users_sparse_fit():
+    """Reference-scale shape: 100k users x 10k items would be 4 GB dense;
+    sparse fit + blocked recommend must handle it in bounded memory."""
+    rng = np.random.default_rng(3)
+    n_events = 200_000
+    df = DataFrame.from_dict(
+        {
+            "user_idx": rng.integers(0, 100_000, n_events).astype(np.float64),
+            "item_idx": rng.integers(0, 10_000, n_events).astype(np.float64),
+            "rating": np.ones(n_events),
+        }
+    )
+    t0 = time.perf_counter()
+    model = SAR(support_threshold=1).fit(df)
+    dt = time.perf_counter() - t0
+    assert _is_sparse(model.get(model.user_affinity))
+    assert dt < 60, f"sparse SAR fit too slow: {dt:.1f}s"
+    # blocked scoring of a subset
+    sub = DataFrame.from_dict(
+        {
+            "user_idx": df["user_idx"][:1000],
+            "item_idx": df["item_idx"][:1000],
+        }
+    )
+    pred = model.transform(sub)["prediction"]
+    assert np.isfinite(pred).all() and (pred >= 0).all()
