@@ -1,0 +1,113 @@
+"""Example: the image pipeline end-to-end — download a zoo model, featurize
+images through the truncated network, train a classical learner on the
+features, and explain a prediction with LIME.
+
+Run:  python examples/image_featurize_explain.py
+(Set JAX_PLATFORMS=cpu on machines without an accelerator.)
+
+This is the reference's CIFAR transfer-learning + ImageLIME story on the
+TPU-native stack.
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType
+from mmlspark_tpu.core.pipeline import PipelineModel, Transformer
+from mmlspark_tpu.core.schema import make_image_row
+from mmlspark_tpu.downloader import ModelDownloader
+from mmlspark_tpu.images import ImageFeaturizer, ImageLIME
+
+PATCH = 8
+
+
+def make_images(n, seed=0):
+    """Two-patch XOR task images (the zoo model's training distribution)."""
+    rng = np.random.default_rng(seed)
+    imgs = rng.integers(0, 60, size=(n, 32, 32, 3)).astype(np.uint8)
+    p1 = rng.integers(0, 2, n).astype(bool)
+    p2 = rng.integers(0, 2, n).astype(bool)
+    imgs[p1, 4:4 + PATCH, 4:4 + PATCH] = 220
+    imgs[p2, 20:20 + PATCH, 20:20 + PATCH] = 220
+    return imgs, (p1 ^ p2).astype(np.float64)
+
+
+def to_df(imgs):
+    rows = np.empty(len(imgs), dtype=object)
+    for i, im in enumerate(imgs):
+        rows[i] = make_image_row(im, f"img{i}")
+    return DataFrame({"image": Column(rows, DataType.STRUCT)})
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as local_repo:
+        _run(local_repo)
+
+
+def _run(local_repo: str) -> None:
+    # -- download a model from the zoo ---------------------------------------
+    downloader = ModelDownloader(local_repo)
+    schema = downloader.download_by_name("ConvNet")
+    print(f"downloaded {schema.name}_{schema.dataset} "
+          f"(sha256 {schema.hash[:12]}..., layers {schema.layer_names})")
+
+    # -- featurize: truncated network (penultimate activations) --------------
+    imgs, labels = make_images(300, seed=7)
+    df = to_df(imgs)
+    featurizer = ImageFeaturizer(
+        input_col="image", output_col="features", cut_output_layers=1
+    )
+    featurizer.set_model(schema)
+    feats = featurizer.transform(df)["features"]
+    print(f"featurized: {feats.shape}")
+
+    # -- linear probe on the features (transfer learning) --------------------
+    design = np.concatenate([feats, np.ones((len(feats), 1))], axis=1)
+    coef, *_ = np.linalg.lstsq(design[:200], labels[:200] * 2 - 1, rcond=None)
+    acc = ((design[200:] @ coef > 0) == (labels[200:] > 0)).mean()
+    print(f"transfer-learning probe accuracy: {acc:.3f} (XOR task — "
+          "raw-pixel linear probes sit at ~0.5)")
+    assert acc > 0.85
+
+    # -- explain one prediction with LIME ------------------------------------
+    full = ImageFeaturizer(input_col="image", output_col="features",
+                           cut_output_layers=0)
+    full.set_model(schema)
+
+    class Head(Transformer):
+        """Class-1 logit margin as the scalar LIME explains."""
+
+        def transform(self, frame):
+            s = frame["features"]
+            return frame.with_column(
+                "prediction", s[:, 1] - s[:, 0], DataType.DOUBLE
+            )
+
+        def transform_schema(self, schema):
+            return schema
+
+    rng = np.random.default_rng(5)
+    one = rng.integers(0, 60, size=(32, 32, 3)).astype(np.uint8)
+    one[4:4 + PATCH, 4:4 + PATCH] = 220  # exactly one patch -> class 1
+    lime = ImageLIME(model=PipelineModel([full, Head()]),
+                     label_col="prediction")
+    lime.set_n_samples(120).set_cell_size(8.0)
+    out = lime.transform(to_df(one[None]))
+    w = out["weights"][0]
+    sp = out["superpixels"][0]
+    top = sp["clusters"][int(np.argmax(w))]
+    xs = [p[0] for p in top]
+    ys = [p[1] for p in top]
+    print(f"LIME: top superpixel bbox x[{min(xs)},{max(xs)}] "
+          f"y[{min(ys)},{max(ys)}] (the informative patch is x,y in [4,12))")
+    assert max(xs) < 16 and max(ys) < 16
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

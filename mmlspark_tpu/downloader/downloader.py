@@ -29,9 +29,10 @@ _MANIFEST = "MANIFEST.json"
 
 
 def default_zoo_dir() -> str:
-    """The committed in-repo zoo (tools/make_zoo.py populates it)."""
-    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    return os.path.join(here, "models_zoo")
+    """The committed zoo, shipped as package data (tools/make_zoo.py
+    populates it) — present in both editable and wheel installs."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(pkg, "models_zoo")
 
 
 class ModelDownloader:

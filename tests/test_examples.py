@@ -1,0 +1,30 @@
+"""Examples-as-tests: every script in examples/ must run clean end-to-end
+(the reference's notebook-E2E test mode, tools/notebook/tester/)."""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = sorted(glob.glob(os.path.join(REPO, "examples", "*.py")))
+
+
+def test_examples_exist():
+    assert len(EXAMPLES) >= 2
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=[os.path.basename(p) for p in EXAMPLES])
+def test_example_runs(path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, path],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+    )
+    assert res.returncode == 0, (
+        f"{os.path.basename(path)} failed:\n{res.stdout[-2000:]}\n{res.stderr[-2000:]}"
+    )
+    assert "OK" in res.stdout

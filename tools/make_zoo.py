@@ -92,7 +92,8 @@ def main() -> None:
     bundle.save_to_dir(tmp)
 
     repo_dir = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "models_zoo"
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "mmlspark_tpu", "models_zoo",
     )
     schema = ModelDownloader.publish(
         tmp,
