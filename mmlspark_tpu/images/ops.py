@@ -13,26 +13,9 @@ import numpy as np
 
 
 def resize(img: np.ndarray, height: int, width: int) -> np.ndarray:
-    """Bilinear resize (OpenCV INTER_LINEAR semantics: pixel-center mapping)."""
-    h, w = img.shape[:2]
-    if (h, w) == (height, width):
-        return img.copy()
-    out_y = (np.arange(height) + 0.5) * h / height - 0.5
-    out_x = (np.arange(width) + 0.5) * w / width - 0.5
-    y0 = np.clip(np.floor(out_y).astype(int), 0, h - 1)
-    x0 = np.clip(np.floor(out_x).astype(int), 0, w - 1)
-    y1 = np.clip(y0 + 1, 0, h - 1)
-    x1 = np.clip(x0 + 1, 0, w - 1)
-    fy = np.clip(out_y - y0, 0, 1)[:, None, None]
-    fx = np.clip(out_x - x0, 0, 1)[None, :, None]
-    im = img.astype(np.float64)
-    if im.ndim == 2:  # promote BEFORE interpolating so fx/fy broadcast per-pixel
-        im = im[:, :, None]
-    top = im[y0][:, x0] * (1 - fx) + im[y0][:, x1] * fx
-    bot = im[y1][:, x0] * (1 - fx) + im[y1][:, x1] * fx
-    out = top * (1 - fy) + bot * fy
-    out = np.rint(out).astype(img.dtype)
-    return out if img.ndim == 3 else out[:, :, 0]
+    """Bilinear resize (OpenCV INTER_LINEAR semantics: pixel-center mapping).
+    Delegates to resize_batch so there is exactly one interpolation kernel."""
+    return resize_batch(img[None], height, width)[0]
 
 
 def crop(img: np.ndarray, x: int, y: int, height: int, width: int) -> np.ndarray:
@@ -160,3 +143,31 @@ OPS = {
         img, p["aperture_size"], p["sigma"]
     ),
 }
+
+
+def resize_batch(imgs: np.ndarray, height: int, width: int) -> np.ndarray:
+    """Bilinear resize of a uniform (N, H, W, C) batch in one vectorized
+    pass — the ImageTransformer fast path for resize-only pipelines (the
+    ImageFeaturizer prep), replacing N per-image calls."""
+    n, h, w = imgs.shape[:3]
+    if (h, w) == (height, width):
+        return imgs.copy()
+    out_y = (np.arange(height) + 0.5) * h / height - 0.5
+    out_x = (np.arange(width) + 0.5) * w / width - 0.5
+    y0 = np.clip(np.floor(out_y).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(out_x).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    fy = np.clip(out_y - y0, 0, 1)[None, :, None, None]
+    fx = np.clip(out_x - x0, 0, 1)[None, None, :, None]
+    im = imgs.astype(np.float64)
+    if im.ndim == 3:
+        im = im[:, :, :, None]
+    t_l = im[:, y0][:, :, x0]
+    t_r = im[:, y0][:, :, x1]
+    b_l = im[:, y1][:, :, x0]
+    b_r = im[:, y1][:, :, x1]
+    top = t_l * (1 - fx) + t_r * fx
+    bot = b_l * (1 - fx) + b_r * fx
+    out = np.rint(top * (1 - fy) + bot * fy).astype(imgs.dtype)
+    return out if imgs.ndim == 4 else out[:, :, :, 0]

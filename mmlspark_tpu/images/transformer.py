@@ -82,6 +82,27 @@ class ImageTransformer(Transformer, Wrappable):
         stage_list = self.get(self.stages)
         values = df[self.get(self.input_col)]
         out = np.empty(len(values), dtype=object)
+
+        # Fast path: resize-only pipeline over a uniform-shape, no-null
+        # column (the ImageFeaturizer prep) batches the whole column into
+        # one vectorized pass instead of a per-row Python loop.
+        if (
+            len(values)
+            and stage_list
+            and all(st["op"] == "resize" for st in stage_list)
+            and all(v is not None for v in values)
+        ):
+            shapes = {np.asarray(v["data"]).shape for v in values}
+            if len(shapes) == 1:
+                batch = np.stack([np.asarray(v["data"]) for v in values])
+                for st in stage_list:
+                    batch = ops.resize_batch(batch, st["height"], st["width"])
+                for i, row in enumerate(values):
+                    out[i] = make_image_row(batch[i], row.get("path", ""))
+                return df.with_column(
+                    self.get(self.output_col), Column(out, DataType.STRUCT)
+                )
+
         for i, row in enumerate(values):
             if row is None:
                 out[i] = None
@@ -144,7 +165,7 @@ class UnrollImage(Transformer, Wrappable):
 
     def transform(self, df: DataFrame) -> DataFrame:
         values = df[self.get(self.input_col)]
-        rows = []
+        imgs = []
         shape = None
         for row in values:
             img = np.asarray(row["data"])
@@ -157,9 +178,14 @@ class UnrollImage(Transformer, Wrappable):
                     f"UnrollImage needs uniform shapes: {img.shape} vs {shape}; "
                     "resize first"
                 )
-            # HWC -> CHW planes, flattened (reference unroll order)
-            rows.append(np.transpose(img, (2, 0, 1)).reshape(-1).astype(np.float64))
-        out = np.stack(rows) if rows else np.zeros((0, 0))
+            imgs.append(img)
+        # HWC -> CHW planes, flattened (reference unroll order) — one
+        # vectorized transpose over the whole batch
+        out = (
+            np.transpose(np.stack(imgs), (0, 3, 1, 2))
+            .reshape(len(imgs), -1).astype(np.float64)
+            if imgs else np.zeros((0, 0))
+        )
         # Layout metadata: consumers (TPUModel) reorder CHW -> their input
         # layout instead of silently misreading the planes as NHWC
         meta = {}

@@ -1,0 +1,88 @@
+"""Plot helpers: confusion matrix and ROC visualization.
+
+Reference: src/plot/src/main/python/plot.py — confusionMatrix/roc over a
+scored frame via matplotlib. Metrics compute here with the framework's own
+numpy math (no sklearn); matplotlib imports lazily so headless/serving
+deployments never pay for it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+
+
+def confusion_matrix_data(
+    df: DataFrame, y_col: str, y_hat_col: str
+) -> Tuple[np.ndarray, np.ndarray, float]:
+    """(matrix, class labels, accuracy) — counts[i, j] = true class i
+    predicted as class j."""
+    y = np.asarray(df[y_col], np.float64)
+    y_hat = np.asarray(df[y_hat_col], np.float64)
+    labels = np.unique(np.concatenate([y, y_hat]))
+    index = {v: i for i, v in enumerate(labels)}
+    cm = np.zeros((len(labels), len(labels)), np.int64)
+    for t, p in zip(y, y_hat):
+        cm[index[t], index[p]] += 1
+    acc = float((y == y_hat).mean()) if len(y) else 0.0
+    return cm, labels, acc
+
+
+def roc_data(
+    df: DataFrame, y_col: str, score_col: str, thresh: float = 0.5
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(fpr, tpr) curve points sorted by descending score threshold."""
+    y = (np.asarray(df[y_col], np.float64) > thresh).astype(np.int64)
+    s = np.asarray(df[score_col], np.float64)
+    order = np.argsort(-s, kind="stable")
+    y = y[order]
+    tp = np.cumsum(y)
+    fp = np.cumsum(1 - y)
+    n_pos = max(int(y.sum()), 1)
+    n_neg = max(int((1 - y).sum()), 1)
+    tpr = np.concatenate([[0.0], tp / n_pos])
+    fpr = np.concatenate([[0.0], fp / n_neg])
+    return fpr, tpr
+
+
+def confusion_matrix(
+    df: DataFrame,
+    y_col: str,
+    y_hat_col: str,
+    labels: Optional[Sequence] = None,
+    ax=None,
+):
+    """Render the confusion matrix (reference plot.confusionMatrix)."""
+    import matplotlib.pyplot as plt
+
+    cm, found, acc = confusion_matrix_data(df, y_col, y_hat_col)
+    labels = list(labels) if labels is not None else [str(v) for v in found]
+    ax = ax or plt.gca()
+    cmn = cm.astype(float) / np.maximum(cm.sum(axis=1, keepdims=True), 1)
+    ax.imshow(cmn, interpolation="nearest", cmap="Blues", vmin=0, vmax=1)
+    ax.set_xticks(range(len(labels)), labels)
+    ax.set_yticks(range(len(labels)), labels)
+    for i in range(cm.shape[0]):
+        for j in range(cm.shape[1]):
+            ax.text(j, i, str(cm[i, j]), ha="center",
+                    color="white" if cmn[i, j] > 0.5 else "black")
+    ax.set_xlabel("Predicted Label")
+    ax.set_ylabel("True Label")
+    ax.set_title(f"Accuracy = {acc * 100:.1f}%")
+    return ax
+
+
+def roc(df: DataFrame, y_col: str, score_col: str, thresh: float = 0.5, ax=None):
+    """Render the ROC curve (reference plot.roc)."""
+    import matplotlib.pyplot as plt
+
+    fpr, tpr = roc_data(df, y_col, score_col, thresh)
+    ax = ax or plt.gca()
+    ax.plot(fpr, tpr)
+    ax.plot([0, 1], [0, 1], linestyle="--", linewidth=0.8)
+    ax.set_xlabel("False Positive Rate")
+    ax.set_ylabel("True Positive Rate")
+    return ax

@@ -1,0 +1,12 @@
+"""utils — test-data generation, profiling/tracing helpers.
+
+Reference analogs: core/test/datagen (GenerateDataset.scala — randomized
+typed frames for fuzzing) and the tracing/profiling aux subsystem
+(SURVEY.md §5: Timer stage + hooks; here extended with jax.profiler
+integration for real device traces).
+"""
+
+from mmlspark_tpu.utils.datagen import generate_dataset
+from mmlspark_tpu.utils.profiling import annotate, profile_to
+
+__all__ = ["generate_dataset", "annotate", "profile_to"]

@@ -1,0 +1,83 @@
+"""Random typed DataFrame generation for fuzzing and benchmarks.
+
+Reference: core/test/datagen GenerateDataset.scala:16-80 — per-column
+generation options (type, missing-value rate) drive a seeded random frame.
+Here the options are a compact dict spec; the fuzzing sweep and datagen
+tests consume it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Union
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType
+
+# column kind -> generator(rng, n) -> (values, DataType)
+_KINDS = {
+    "double": lambda rng, n: (rng.normal(size=n), DataType.DOUBLE),
+    "int": lambda rng, n: (rng.integers(-100, 100, n).astype(np.int64), DataType.LONG),
+    "bool": lambda rng, n: (rng.integers(0, 2, n).astype(bool), DataType.BOOLEAN),
+    "string": lambda rng, n: (
+        np.array([f"s{v}" for v in rng.integers(0, 20, n)], object),
+        DataType.STRING,
+    ),
+    "category": lambda rng, n: (
+        np.array(list("abcde"), object)[rng.integers(0, 5, n)],
+        DataType.STRING,
+    ),
+    "vector": lambda rng, n: (rng.normal(size=(n, 4)), DataType.VECTOR),
+    "label": lambda rng, n: (rng.integers(0, 2, n).astype(np.float64), DataType.DOUBLE),
+    "text": lambda rng, n: (
+        np.array(
+            [
+                " ".join(
+                    np.array(["alpha", "beta", "gamma", "delta", "eps"], object)[
+                        rng.integers(0, 5, rng.integers(2, 6))
+                    ]
+                )
+                for _ in range(n)
+            ],
+            object,
+        ),
+        DataType.STRING,
+    ),
+}
+
+
+def generate_dataset(
+    columns: Union[Dict[str, str], Dict[str, Dict[str, Any]]],
+    n_rows: int = 100,
+    seed: int = 0,
+    missing_ratio: float = 0.0,
+) -> DataFrame:
+    """Seeded random frame from a {name: kind} (or {name: {"kind": ...,
+    "missing": ratio}}) spec. Kinds: double | int | bool | string |
+    category | vector | label | text.
+
+    generate_dataset({"x": "vector", "label": "label", "note": "text"}, 50)
+    """
+    rng = np.random.default_rng(seed)
+    cols: Dict[str, Column] = {}
+    for name, spec in columns.items():
+        opts = {"kind": spec} if isinstance(spec, str) else dict(spec)
+        kind = opts["kind"]
+        if kind not in _KINDS:
+            raise ValueError(f"unknown column kind {kind!r}; have {sorted(_KINDS)}")
+        values, dtype = _KINDS[kind](rng, n_rows)
+        miss = float(opts.get("missing", missing_ratio))
+        if miss > 0:
+            mask = rng.random(n_rows) < miss
+            if values.dtype == object:
+                values = values.copy()
+                values[mask] = None
+            elif values.ndim == 2:  # vector column: NaN whole rows, keep dtype
+                values = values.astype(np.float64)
+                values[mask, :] = np.nan
+            else:
+                values = values.astype(np.float64)
+                values[mask] = np.nan
+                dtype = DataType.DOUBLE
+        cols[name] = Column(values, dtype)
+    return DataFrame(cols)

@@ -1,0 +1,66 @@
+"""Profiling/tracing hooks over jax.profiler.
+
+Reference aux subsystem (SURVEY.md §5 tracing): the Timer stage wraps
+wall-clock around a stage; these helpers add DEVICE-level visibility — a
+TensorBoard-loadable XLA trace (`profile_to`) and named trace annotations
+(`annotate`) that appear inside it. Use around any transform/fit to see
+dispatch gaps, fusion, and HBM traffic on real hardware.
+
+    with profile_to("/tmp/trace"):
+        with annotate("gbdt-fit"):
+            model = clf.fit(df)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+from mmlspark_tpu.core.config import get_logger
+
+log = get_logger("mmlspark_tpu.profiling")
+
+
+@contextlib.contextmanager
+def profile_to(logdir: str) -> Iterator[None]:
+    """Capture a jax.profiler device trace into `logdir` (TensorBoard
+    format). Wall-clock for the block is logged either way."""
+    import jax
+
+    t0 = time.perf_counter()
+    with jax.profiler.trace(logdir):
+        yield
+    log.info("profile_to(%s): %.3fs traced", logdir, time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def annotate(name: str, **kwargs) -> Iterator[None]:
+    """Named region that shows up inside device traces (TraceAnnotation);
+    also logs host wall-clock at debug level."""
+    import jax
+
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name, **kwargs):
+        yield
+    log.debug("annotate(%s): %.3fs", name, time.perf_counter() - t0)
+
+
+class StageTimer:
+    """Accumulating named timer for host-side phases (the Timer stage's
+    programmatic sibling): timer.time('binning') blocks accumulate and
+    report() returns {name: seconds}."""
+
+    def __init__(self) -> None:
+        self._acc: dict = {}
+
+    @contextlib.contextmanager
+    def time(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._acc[name] = self._acc.get(name, 0.0) + time.perf_counter() - t0
+
+    def report(self) -> dict:
+        return dict(self._acc)

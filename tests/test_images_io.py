@@ -223,3 +223,37 @@ def test_text_preprocessor_uppercase_keys():
     tp = TextPreprocessor(map={"USA": "United States"}, input_col="t", output_col="o")
     # keys normalize with the text; replacement values keep their case
     assert list(tp.transform(df)["o"]) == ["i love the United States"]
+
+
+class TestResizeBatchParity:
+    def test_batch_matches_per_image(self):
+        from mmlspark_tpu.images import ops
+
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 255, size=(6, 21, 17, 3)).astype(np.uint8)
+        batch = ops.resize_batch(imgs, 8, 11)
+        for i in range(6):
+            np.testing.assert_array_equal(batch[i], ops.resize(imgs[i], 8, 11))
+
+    def test_transformer_fast_path_matches_loop(self):
+        from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType
+        from mmlspark_tpu.core.schema import make_image_row
+        from mmlspark_tpu.images import ImageTransformer
+
+        rng = np.random.default_rng(1)
+        rows = np.empty(5, dtype=object)
+        for i in range(5):
+            rows[i] = make_image_row(
+                rng.integers(0, 255, size=(20, 20, 3)).astype(np.uint8), f"p{i}"
+            )
+        df = DataFrame({"image": Column(rows, DataType.STRUCT)})
+        fast = ImageTransformer("image", "out").resize(9, 9).transform(df)
+        # mixed pipeline (resize+flip) exercises the per-row path
+        slow = (
+            ImageTransformer("image", "out").resize(9, 9).flip(1).transform(df)
+        )
+        for i in range(5):
+            a = np.asarray(fast["out"][i]["data"])
+            b = np.asarray(slow["out"][i]["data"])[:, ::-1]
+            np.testing.assert_array_equal(a, b)
+            assert fast["out"][i]["path"] == f"p{i}"

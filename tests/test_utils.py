@@ -1,0 +1,112 @@
+"""Tests: datagen, profiling helpers, plot helpers."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.plot import confusion_matrix_data, roc_data
+from mmlspark_tpu.utils import annotate, generate_dataset, profile_to
+from mmlspark_tpu.utils.profiling import StageTimer
+
+
+class TestDatagen:
+    def test_kinds_and_seeding(self):
+        spec = {
+            "x": "vector", "label": "label", "name": "string",
+            "cat": "category", "n": "int", "flag": "bool", "note": "text",
+        }
+        a = generate_dataset(spec, n_rows=50, seed=3)
+        b = generate_dataset(spec, n_rows=50, seed=3)
+        assert len(a) == 50
+        assert a["x"].shape == (50, 4)
+        assert a.dtype("name") == DataType.STRING
+        np.testing.assert_array_equal(a["n"], b["n"])  # seeded
+        assert set(a["cat"]) <= set("abcde")
+
+    def test_missing_values(self):
+        df = generate_dataset(
+            {"v": {"kind": "double", "missing": 0.5}, "s": {"kind": "string", "missing": 0.3}},
+            n_rows=400, seed=1,
+        )
+        assert 0.3 < np.isnan(df["v"]).mean() < 0.7
+        assert 0.1 < np.mean([v is None for v in df["s"]]) < 0.5
+
+    def test_unknown_kind(self):
+        with pytest.raises(ValueError, match="unknown column kind"):
+            generate_dataset({"x": "quux"})
+
+    def test_feeds_a_stage(self):
+        from mmlspark_tpu.gbdt import LightGBMClassifier
+
+        df = generate_dataset({"features": "vector", "label": "label"}, 80, seed=2)
+        model = LightGBMClassifier(num_iterations=3, num_leaves=4).fit(df)
+        assert len(model.transform(df)) == 80
+
+
+class TestProfiling:
+    def test_profile_to_writes_trace(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        logdir = str(tmp_path / "trace")
+        with profile_to(logdir):
+            with annotate("matmul"):
+                x = jnp.ones((64, 64))
+                jax.block_until_ready(x @ x)
+        found = []
+        for root, _dirs, files in os.walk(logdir):
+            found.extend(files)
+        assert found, "no trace files written"
+
+    def test_stage_timer(self):
+        t = StageTimer()
+        with t.time("a"):
+            pass
+        with t.time("a"):
+            pass
+        with t.time("b"):
+            pass
+        rep = t.report()
+        assert set(rep) == {"a", "b"} and rep["a"] >= 0
+
+
+class TestPlot:
+    def _df(self):
+        y = np.array([0, 0, 1, 1, 1], np.float64)
+        yh = np.array([0, 1, 1, 1, 0], np.float64)
+        s = np.array([0.1, 0.6, 0.8, 0.9, 0.4])
+        return DataFrame.from_dict({"y": y, "yh": yh, "s": s})
+
+    def test_confusion_matrix_data(self):
+        cm, labels, acc = confusion_matrix_data(self._df(), "y", "yh")
+        np.testing.assert_array_equal(labels, [0.0, 1.0])
+        np.testing.assert_array_equal(cm, [[1, 1], [1, 2]])
+        assert acc == pytest.approx(0.6)
+
+    def test_roc_data_monotone(self):
+        fpr, tpr = roc_data(self._df(), "y", "s")
+        assert fpr[0] == 0 and tpr[0] == 0
+        assert fpr[-1] == 1 and tpr[-1] == 1
+        assert (np.diff(fpr) >= 0).all() and (np.diff(tpr) >= 0).all()
+
+    def test_render(self, tmp_path):
+        import matplotlib
+
+        matplotlib.use("Agg")
+        from mmlspark_tpu.plot import confusion_matrix, roc
+
+        ax = confusion_matrix(self._df(), "y", "yh")
+        assert ax.get_xlabel() == "Predicted Label"
+        ax2 = roc(self._df(), "y", "s")
+        assert ax2.get_ylabel() == "True Positive Rate"
+
+
+def test_datagen_vector_missing_keeps_dtype():
+    df = generate_dataset({"x": {"kind": "vector", "missing": 0.4}}, 200, seed=5)
+    assert df.dtype("x") == DataType.VECTOR
+    assert df["x"].shape == (200, 4)
+    row_nan = np.isnan(df["x"]).all(axis=1)
+    assert 0.2 < row_nan.mean() < 0.6
+    assert not np.isnan(df["x"][~row_nan]).any()
