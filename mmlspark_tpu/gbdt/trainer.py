@@ -238,6 +238,7 @@ def train_booster(
     cat_dev = jnp.asarray(np.asarray(categorical, bool))
     full_fmask_dev = jnp.asarray(np.ones(f, bool))
     num_bins_static = int(max(binner.n_bins))
+    n_bins_static = tuple(int(b) for b in binner.n_bins)  # hist grouping
 
     rng = np.random.default_rng(cfg.bagging_seed)
     frng = np.random.default_rng(cfg.bagging_seed + 17)
@@ -368,6 +369,7 @@ def train_booster(
             num_class=k,
             rf=rf_mode,
             has_w=w_dev is not None,
+            n_bins_static=n_bins_static,
         )
         packs = np.asarray(packs_dev)  # ONE D2H for the whole fit
         if k > 1:
@@ -457,6 +459,7 @@ def train_booster(
                 bins_dev, gc, hc, mask_dev,
                 n_bins_dev, cat_dev, fmask_dev,
                 num_bins_static, grow_cfg,
+                n_bins_static=n_bins_static,
             )
             if dart_mode:
                 tree = unpack_tree(

@@ -232,6 +232,7 @@ def grow_tree_packed(
     feature_mask_dev, # (F,) bool device
     num_bins: int,
     cfg: GrowConfig,
+    n_bins_static=None,  # hashable per-feature bin counts (hist grouping)
 ):
     """Device-only tree growth: ONE dispatch, nothing fetched. Returns
     (packed_device, assign_device, leaf_values_device); decode the packed
@@ -258,6 +259,7 @@ def grow_tree_packed(
         num_leaves=L,
         depth_limit=int(cfg.max_depth) if cfg.max_depth > 0 else L,
         max_cat_threshold=int(cfg.max_cat_threshold),
+        n_bins_static=n_bins_static,
     )
 
 
@@ -293,6 +295,7 @@ def grow_tree(
         jnp.asarray(np.asarray(categorical, bool)),
         jnp.asarray(fm),
         num_bins, cfg,
+        n_bins_static=tuple(int(b) for b in n_bins),
     )
     tree = unpack_tree(
         np.asarray(packed), int(cfg.num_leaves), num_bins,
