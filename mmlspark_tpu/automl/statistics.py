@@ -261,3 +261,36 @@ class ComputePerInstanceStatistics(Transformer, HasLabelCol, HasEvaluationMetric
         err = preds - labels
         out = df.with_column("L1_loss", np.abs(err), DataType.DOUBLE)
         return out.with_column("L2_loss", err ** 2, DataType.DOUBLE)
+
+
+class MetricsLogger:
+    """Push scalar metrics into the framework logger under a run name
+    (reference: ComputeModelStatistics.scala:469-489 MetricsLogger — the
+    hook build dashboards scrape). Usage:
+
+        MetricsLogger("my-experiment").log_metrics_df(stats_df)
+    """
+
+    def __init__(self, run_name: str = "run"):
+        from mmlspark_tpu.core.config import get_logger
+
+        self.run_name = run_name
+        self._log = get_logger("mmlspark_tpu.metrics")
+
+    def log_metric(self, name: str, value: float) -> None:
+        self._log.info("metric %s/%s=%r", self.run_name, name, float(value))
+
+    def log_metrics(self, metrics: dict) -> None:
+        for name in sorted(metrics):
+            v = metrics[name]
+            if isinstance(v, (int, float, np.floating, np.integer)):
+                self.log_metric(name, v)
+
+    def log_metrics_df(self, df: DataFrame) -> None:
+        """Log every scalar cell of a (typically one-row) metrics frame."""
+        for name in df.columns:
+            col = df[name]
+            for i, v in enumerate(np.asarray(col).reshape(-1)[:8]):
+                if isinstance(v, (int, float, np.floating, np.integer)):
+                    suffix = f"[{i}]" if len(col) > 1 else ""
+                    self.log_metric(f"{name}{suffix}", v)

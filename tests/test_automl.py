@@ -292,3 +292,19 @@ class TestReviewRegressions:
         m = LogisticRegression(max_iter=10).fit(df)
         best = FindBestModel([m], evaluation_metric=M.ACCURACY).fit(df)
         assert best.get_best_model() is m
+
+
+class TestMetricsLogger:
+    def test_logs_scalar_metrics(self, caplog):
+        import logging
+
+        from mmlspark_tpu.automl.statistics import MetricsLogger
+
+        with caplog.at_level(logging.INFO, logger="mmlspark_tpu.metrics"):
+            ml = MetricsLogger("exp1")
+            ml.log_metrics({"auc": 0.93, "name": "not-a-number"})
+            ml.log_metrics_df(DataFrame.from_dict({"accuracy": [0.875]}))
+        text = caplog.text
+        assert "exp1/auc=0.93" in text
+        assert "exp1/accuracy=0.875" in text
+        assert "not-a-number" not in text
