@@ -239,6 +239,7 @@ def train_booster(
     full_fmask_dev = jnp.asarray(np.ones(f, bool))
     num_bins_static = int(max(binner.n_bins))
     n_bins_static = tuple(int(b) for b in binner.n_bins)  # hist grouping
+    cat_static = tuple(bool(x) for x in categorical)      # reduced cat view
 
     rng = np.random.default_rng(cfg.bagging_seed)
     frng = np.random.default_rng(cfg.bagging_seed + 17)
@@ -370,6 +371,7 @@ def train_booster(
             rf=rf_mode,
             has_w=w_dev is not None,
             n_bins_static=n_bins_static,
+            cat_static=cat_static,
         )
         packs = np.asarray(packs_dev)  # ONE D2H for the whole fit
         if k > 1:
@@ -460,6 +462,7 @@ def train_booster(
                 n_bins_dev, cat_dev, fmask_dev,
                 num_bins_static, grow_cfg,
                 n_bins_static=n_bins_static,
+                cat_static=cat_static,
             )
             if dart_mode:
                 tree = unpack_tree(

@@ -233,6 +233,7 @@ def grow_tree_packed(
     num_bins: int,
     cfg: GrowConfig,
     n_bins_static=None,  # hashable per-feature bin counts (hist grouping)
+    cat_static=None,     # hashable per-feature categorical flags
 ):
     """Device-only tree growth: ONE dispatch, nothing fetched. Returns
     (packed_device, assign_device, leaf_values_device); decode the packed
@@ -260,6 +261,7 @@ def grow_tree_packed(
         depth_limit=int(cfg.max_depth) if cfg.max_depth > 0 else L,
         max_cat_threshold=int(cfg.max_cat_threshold),
         n_bins_static=n_bins_static,
+        cat_static=cat_static,
     )
 
 
@@ -296,6 +298,7 @@ def grow_tree(
         jnp.asarray(fm),
         num_bins, cfg,
         n_bins_static=tuple(int(b) for b in n_bins),
+        cat_static=tuple(bool(x) for x in categorical),
     )
     tree = unpack_tree(
         np.asarray(packed), int(cfg.num_leaves), num_bins,
