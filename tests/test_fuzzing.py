@@ -675,11 +675,11 @@ EXEMPT = {
     "mmlspark_tpu.stages.dataprep.SummarizeData":
         "emits a summary table (different schema); covered by tests/test_stages.py",
     "mmlspark_tpu.stages.batching.DynamicMiniBatchTransformer":
-        "timing-dependent batching; covered by tests/test_stages.py",
+        "array-ifies every column (different output schema); covered by tests/test_dnn.py",
     "mmlspark_tpu.stages.batching.TimeIntervalMiniBatchTransformer":
-        "timing-dependent batching; covered by tests/test_stages.py",
+        "array-ifies every column; covered by tests/test_stages.py test_time_interval_minibatch",
     "mmlspark_tpu.stages.batching.FixedMiniBatchTransformer":
-        "buffered/streaming semantics; covered by tests/test_stages.py",
+        "array-ifies every column; covered by tests/test_dnn.py",
     "mmlspark_tpu.automl.find_best.BestModel":
         "constructed by FindBestModel.fit; swept via its estimator",
     "mmlspark_tpu.io.cognitive.CognitiveServiceBase":

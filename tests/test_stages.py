@@ -218,3 +218,22 @@ def test_stage_persistence_roundtrip(tmp_path):
 
     loaded = ValueIndexerModel.load(path)
     np.testing.assert_array_equal(loaded.transform(df)["bi"], model.transform(df)["bi"])
+
+
+def test_time_interval_minibatch():
+    """Over a materialized frame the interval batcher reduces to dynamic
+    batching bounded by max_batch_size; FlattenBatch inverts it."""
+    from mmlspark_tpu.stages.batching import (
+        FlattenBatch,
+        TimeIntervalMiniBatchTransformer,
+    )
+
+    df = DataFrame.from_dict({"x": np.arange(10.0)})
+    batched = TimeIntervalMiniBatchTransformer(
+        millis_to_wait=5, max_batch_size=4
+    ).transform(df)
+    sizes = [len(b) for b in batched["x"]]
+    assert sum(sizes) == 10
+    assert max(sizes) <= 4
+    flat = FlattenBatch().transform(batched)
+    np.testing.assert_allclose(flat["x"], np.arange(10.0))
