@@ -536,6 +536,8 @@ def boost_loop_fused(
     has_w: bool,
     n_bins_static=None,
     cat_static=None,
+    valid_idx=None,  # (n_v,) int32 — when given, each iteration also emits
+                     # raw scores at these rows (early-stopping eval on host)
 ):
     """The ENTIRE boosting loop in one XLA program: lax.scan over K
     iterations of (gradients -> fused tree growth -> raw-score update).
@@ -550,7 +552,9 @@ def boost_loop_fused(
 
     Returns (packs, raw): packs (K, P) f32 for num_class==1 else
     (K, num_class, P) — each row decodes with tree.unpack_tree — and the
-    final raw scores.
+    final raw scores. With valid_idx, returns (packs, raw, valid_raws)
+    where valid_raws (K, n_v) or (K, n_v, k) holds each iteration's raw
+    scores at the valid rows (host applies the early-stopping rule).
 
     rf mode: gradients are taken at raw0 for every tree (bagged fits to the
     initial gradients, trainer semantics); raw still accumulates so the
@@ -569,6 +573,11 @@ def boost_loop_fused(
         cat_static=cat_static,
     )
 
+    def out(raw, packed):
+        if valid_idx is None:
+            return packed
+        return packed, raw[valid_idx]  # per-iteration valid-row snapshot
+
     def body(raw, xs):
         mi, fmask = xs
         smask = sample_masks[mi]
@@ -586,16 +595,20 @@ def boost_loop_fused(
                 )
                 raw = raw.at[:, c].add(lv[assign])
                 packs.append(packed)
-            return raw, jnp.stack(packs)
+            return raw, out(raw, jnp.stack(packs))
         packed, lv, assign = _grow_tree_body(
             bins, g, h, smask, n_bins_arr, categorical_arr, fmask,
             min_data, min_hess, l1, l2, min_gain, learning_rate,
             **grow_kwargs,
         )
-        return raw + lv[assign], packed
+        raw = raw + lv[assign]
+        return raw, out(raw, packed)
 
-    raw, packs = jax.lax.scan(body, raw0, (mask_idx, fmasks))
-    return packs, raw
+    raw, ys = jax.lax.scan(body, raw0, (mask_idx, fmasks))
+    if valid_idx is None:
+        return ys, raw
+    packs, valid_raws = ys
+    return packs, raw, valid_raws  # valid_raws: (K, n_v) or (K, n_v, k)
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))

@@ -44,6 +44,43 @@ _FORCE_SINGLE_DEVICE = False
 _FORCE_LEGACY_LOOP = False
 
 
+class _ValidTracker:
+    """The early-stopping rule, shared verbatim by the legacy loop and the
+    fused fast path so the two can never drift: tracks best metric/iter,
+    logs every 10 iterations, and says when to stop."""
+
+    def __init__(self, objective, vy, early_stopping_round: int,
+                 verbosity: int, log) -> None:
+        self.objective = objective
+        self.vy = vy
+        self.esr = early_stopping_round
+        self.verbosity = verbosity
+        self.log = log
+        self.best_metric = None
+        self.best_iter = -1
+        self.larger_better = False
+
+    def update(self, vraw, it: int) -> bool:
+        """Evaluate iteration `it`'s valid scores; True => stop now."""
+        name, value, larger = self.objective.eval_metric(vraw, self.vy)
+        self.larger_better = larger
+        improved = (
+            self.best_metric is None
+            or (value > self.best_metric if larger else value < self.best_metric)
+        )
+        if improved:
+            self.best_metric, self.best_iter = value, it
+        if self.verbosity > 0 and (it % 10 == 0):
+            self.log.info("iter %d %s=%.6f", it, name, value)
+        if self.esr > 0 and it - self.best_iter >= self.esr:
+            self.log.info(
+                "early stop at iter %d (best %d, %s=%.6f)",
+                it, self.best_iter, name, self.best_metric,
+            )
+            return True
+        return False
+
+
 class _DeferredTree:
     """A grown tree still living on device as grow_tree_fused's packed
     buffer; fetched+decoded once at the end of the fit."""
@@ -257,11 +294,16 @@ def train_booster(
     bag_mask = train_rows.copy()
     use_bagging = (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0) or rf_mode
 
-    # early stopping bookkeeping
-    best_metric = None
-    best_iter = -1
+    # early stopping bookkeeping (shared rule, see _ValidTracker)
     has_valid = valid_mask is not None and valid_mask.any()
-    metric_larger_better = False
+    tracker = (
+        _ValidTracker(
+            objective, y_host[valid_mask], cfg.early_stopping_round,
+            cfg.verbosity, log,
+        )
+        if has_valid
+        else None
+    )
 
     tree_contrib_cache: Dict[int, Any] = {}  # dart: tree idx -> (n,) contrib
 
@@ -303,15 +345,20 @@ def train_booster(
         return outs[:, 0]
 
     # -- FAST PATH: whole boosting loop in ONE device program ----------------
-    # gbdt/rf without valid-set eval, dart or goss ride compute.
-    # boost_loop_fused: a lax.scan over all iterations (gradients + fused
-    # grower + raw update), so the fit costs ~1 dispatch instead of ~3 per
-    # iteration — each dispatch/sync through a remote-chip tunnel can cost
-    # ~100 ms, which at 100 iterations was the whole 30 s fit (BASELINE.md).
-    # Bagging/feature-fraction draws replicate the legacy loop's host rng
-    # sequence exactly, so trees are identical to the per-iteration path.
+    # gbdt/rf ride boost_loop_fused — a lax.scan over all iterations
+    # (gradients + fused grower + raw update), so the fit costs ~1 dispatch
+    # instead of ~3 per iteration — each dispatch/sync through a remote-chip
+    # tunnel can cost ~100 ms, which at 100 iterations was the whole 30 s
+    # fit (BASELINE.md). Bagging/feature-fraction draws replicate the legacy
+    # loop's host rng sequence exactly, so trees are identical to the
+    # per-iteration path. Valid-set eval/early stopping: the scan emits
+    # per-iteration valid-row scores and the host applies the exact legacy
+    # stopping rule post-hoc (extra device iterations past the stop point
+    # are wasted compute, far cheaper than per-iteration dispatches).
+    # dart mutates past trees and goss samples by current |gradient| rank —
+    # both stay on the legacy loop.
     fast_path = (
-        not dart_mode and not goss_mode and not has_valid
+        not dart_mode and not goss_mode
         and cfg.num_iterations > 0
         and not _FORCE_LEGACY_LOOP
     )
@@ -348,7 +395,8 @@ def train_booster(
         else:
             bank_dev = jax.device_put(np.stack(mask_bank))
         w_arg = w_dev if w_dev is not None else y_dev
-        packs_dev, raw = boost_loop_fused(
+        vrows = np.flatnonzero(valid_mask) if has_valid else None
+        result = boost_loop_fused(
             bins_dev, y_dev, w_arg, raw,
             bank_dev,
             jnp.asarray(np.asarray(mask_idx, np.int32)),
@@ -372,11 +420,34 @@ def train_booster(
             has_w=w_dev is not None,
             n_bins_static=n_bins_static,
             cat_static=cat_static,
+            valid_idx=(
+                jnp.asarray(vrows.astype(np.int32)) if has_valid else None
+            ),
         )
-        packs = np.asarray(packs_dev)  # ONE D2H for the whole fit
+        if has_valid:
+            packs_dev, raw, vraws_dev = result
+        else:
+            packs_dev, raw = result
+
+        keep_iters = cfg.num_iterations
+        if has_valid:
+            # the shared stopping rule over the captured per-iteration valid
+            # scores — identical best_iter/truncation to the legacy loop;
+            # runs BEFORE unpacking so discarded trees are never decoded
+            vraws = np.asarray(vraws_dev)  # second (small) fetch: (K, n_v[,k])
+            init_v = np.asarray(raw_init)[vrows] if rf_mode else None
+            for it_rel in range(cfg.num_iterations):
+                vraw = vraws[it_rel]
+                if rf_mode:
+                    vraw = init_v + (vraw - init_v) / (it_rel + 1)
+                if tracker.update(vraw, start_iter + it_rel):
+                    keep_iters = tracker.best_iter - start_iter + 1
+                    break
+
+        packs = np.asarray(packs_dev)  # the one big D2H: all packed trees
         if k > 1:
             packs = packs.reshape(cfg.num_iterations * k, -1)
-        for row in packs:
+        for row in packs[: keep_iters * k]:
             trees.append(
                 unpack_tree(row, cfg.num_leaves, num_bins_static,
                             binner.threshold_value, grow_cfg)
@@ -499,27 +570,8 @@ def train_booster(
                 n_trees_now = (it - start_iter + 1)
                 init_np = np.asarray(raw_init)[:n_orig]
                 raw_np = init_np + (raw_np - init_np) / n_trees_now
-            vraw = raw_np[valid_mask]
-            vy = y_host[valid_mask]
-            name, value, larger = objective.eval_metric(vraw, vy)
-            metric_larger_better = larger
-            improved = (
-                best_metric is None
-                or (value > best_metric if larger else value < best_metric)
-            )
-            if improved:
-                best_metric, best_iter = value, it
-            if cfg.verbosity > 0 and (it % 10 == 0):
-                log.info("iter %d %s=%.6f", it, name, value)
-            if (
-                cfg.early_stopping_round > 0
-                and it - best_iter >= cfg.early_stopping_round
-            ):
-                log.info(
-                    "early stop at iter %d (best %d, %s=%.6f)",
-                    it, best_iter, name, best_metric,
-                )
-                trees = trees[: (best_iter + 1) * k]
+            if tracker.update(raw_np[valid_mask], it):
+                trees = trees[: (tracker.best_iter + 1) * k]
                 break
 
     trees = [

@@ -446,3 +446,32 @@ class TestGrowerParity:
             np.testing.assert_allclose(
                 tf_.leaf_value, tl.leaf_value, rtol=2e-4, atol=1e-6
             )
+
+    def test_fused_early_stopping_matches_legacy(self):
+        """Valid-set eval rides the fused scan: the post-hoc stopping rule
+        must reproduce the legacy loop's best_iter, truncation, and trees."""
+        from mmlspark_tpu.gbdt import trainer as trainer_mod
+
+        df, y = _binary_df(n=600, d=6, seed=11, noise=2.5)
+        kw = dict(
+            num_iterations=60, num_leaves=7, learning_rate=0.3,
+            validation_indicator_col="is_val", early_stopping_round=5,
+        )
+        val = np.zeros(600, bool)
+        val[480:] = True
+        df = df.with_column("is_val", val)
+
+        fused = LightGBMClassifier(**kw).fit(df).get_booster()
+        trainer_mod._FORCE_LEGACY_LOOP = True
+        try:
+            legacy = LightGBMClassifier(**kw).fit(df).get_booster()
+        finally:
+            trainer_mod._FORCE_LEGACY_LOOP = False
+        assert len(fused.trees) == len(legacy.trees)
+        assert len(fused.trees) < 60  # early stopping actually triggered
+        for tf_, tl in zip(fused.trees, legacy.trees):
+            assert tf_.split_feature == tl.split_feature
+            assert tf_.threshold_bin == tl.threshold_bin
+            np.testing.assert_allclose(
+                tf_.leaf_value, tl.leaf_value, rtol=2e-4, atol=1e-6
+            )
