@@ -81,7 +81,9 @@ def bench_cifar():
 
     # device-resident: inputs pre-staged in HBM, outputs left on device —
     # pure (MXU compute + dispatch) throughput
-    fn = model._compiled(str(net.spec), BATCH)
+    from mmlspark_tpu.models.tpu_model import _compiled_forward
+
+    fn = _compiled_forward(net)
     x_dev = [
         jax.device_put(imgs[i : i + BATCH].reshape(-1, 32, 32, 3))
         for i in range(0, N_IMAGES, BATCH)

@@ -10,6 +10,21 @@ a configurable compute dtype (bfloat16 on TPU), static shapes throughout.
 """
 
 from mmlspark_tpu.dnn.network import LAYER_KINDS, Network, layer
-from mmlspark_tpu.dnn.resnet import mlp, resnet20_cifar, resnet_mini
+from mmlspark_tpu.dnn.resnet import (
+    mlp,
+    resnet20_cifar,
+    resnet50,
+    resnet_imagenet,
+    resnet_mini,
+)
 
-__all__ = ["LAYER_KINDS", "Network", "layer", "mlp", "resnet20_cifar", "resnet_mini"]
+__all__ = [
+    "LAYER_KINDS",
+    "Network",
+    "layer",
+    "mlp",
+    "resnet20_cifar",
+    "resnet50",
+    "resnet_imagenet",
+    "resnet_mini",
+]

@@ -225,6 +225,8 @@ def _pool_shape(cfg, in_shape):
     k = cfg.get("size", 2)
     s = cfg.get("stride", k)
     h, w, c = in_shape
+    if cfg.get("padding", "VALID") == "SAME":
+        return (-(-h // s), -(-w // s), c)
     return ((h - k) // s + 1, (w - k) // s + 1, c)
 
 
@@ -236,7 +238,8 @@ def _max_pool():
         k = cfg.get("size", 2)
         s = cfg.get("stride", k)
         return jax.lax.reduce_window(
-            x, -np.inf, jax.lax.max, (1, k, k, 1), (1, s, s, 1), "VALID"
+            x, -np.inf, jax.lax.max, (1, k, k, 1), (1, s, s, 1),
+            cfg.get("padding", "VALID"),
         )
 
     return _stateless(fn, _pool_shape)
@@ -246,12 +249,22 @@ def _max_pool():
 def _avg_pool():
     def fn(cfg, x):
         import jax
+        import jax.numpy as jnp
 
         k = cfg.get("size", 2)
         s = cfg.get("stride", k)
+        padding = cfg.get("padding", "VALID")
         summed = jax.lax.reduce_window(
-            x, 0.0, jax.lax.add, (1, k, k, 1), (1, s, s, 1), "VALID"
+            x, 0.0, jax.lax.add, (1, k, k, 1), (1, s, s, 1), padding
         )
+        if padding == "SAME":
+            # edge windows overlap the zero pad: divide by the REAL element
+            # count per window, not k*k (count_include_pad=False semantics)
+            ones = jnp.ones((1,) + x.shape[1:3] + (1,), x.dtype)
+            counts = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add, (1, k, k, 1), (1, s, s, 1), padding
+            )
+            return summed / counts
         return summed / (k * k)
 
     return _stateless(fn, _pool_shape)
@@ -323,6 +336,49 @@ def _residual():
         return y + s, {"body": new_bs, "shortcut": new_ss}
 
     return init, apply
+
+
+# -- FLOPs accounting (MFU reporting in bench.py) ------------------------------
+
+
+def _spec_flops(spec: Spec, in_shape) -> Tuple[float, Tuple[int, ...]]:
+    """(multiply-add FLOPs per example, output shape) for one spec walk.
+    Counts the MXU work only (convs + dense, 2*MACs); elementwise/BN/pool
+    FLOPs are noise next to the matmuls and XLA fuses them anyway."""
+    flops = 0.0
+    shape = tuple(in_shape)
+    for cfg in spec:
+        kind = cfg["kind"]
+        if kind == "conv":
+            k = cfg.get("kernel", 3)
+            kh, kw = (k, k) if not isinstance(k, (list, tuple)) else k
+            s = cfg.get("stride", 1)
+            h, w, c_in = shape
+            if cfg.get("padding", "SAME") == "SAME":
+                oh, ow = -(-h // s), -(-w // s)
+            else:
+                oh, ow = (h - kh) // s + 1, (w - kw) // s + 1
+            c_out = cfg["filters"]
+            flops += 2.0 * kh * kw * c_in * c_out * oh * ow
+            shape = (oh, ow, c_out)
+        elif kind == "dense":
+            d_in = int(np.prod(shape))
+            d_out = cfg["units"]
+            flops += 2.0 * d_in * d_out
+            shape = (d_out,)
+        elif kind in ("max_pool", "avg_pool"):
+            shape = _pool_shape(cfg, shape)
+        elif kind == "global_avg_pool":
+            shape = (shape[-1],)
+        elif kind == "flatten":
+            shape = (int(np.prod(shape)),)
+        elif kind == "residual":
+            body_f, body_shape = _spec_flops(cfg["body"], shape)
+            short_f, _ = _spec_flops(cfg.get("shortcut") or [], shape)
+            flops += body_f + short_f
+            shape = body_shape
+        # batchnorm / activations / dropout: shape-preserving, ~0 MXU FLOPs
+    return flops, shape
 
 
 # -- spec walking --------------------------------------------------------------
@@ -416,6 +472,12 @@ class Network:
         _, _, shape = _init_spec(jax.random.PRNGKey(0), self.spec, self.input_shape)
         return shape
 
+    def flops_per_example(self) -> float:
+        """Forward-pass multiply-add FLOPs per example (MXU work only) —
+        the numerator of bench.py's MFU lines."""
+        flops, _ = _spec_flops(self.spec, self.input_shape)
+        return flops
+
     def truncate(self, cut_output_layers: int) -> "Network":
         """Drop the last N layers — the reference's `cutOutputLayers`
         headless-featurization semantics (ImageFeaturizer.scala:129-177)."""
@@ -507,6 +569,47 @@ class Network:
         return tree
 
 
+def deterministic_variables(net: "Network", seed: int = 0) -> dict:
+    """Platform-independent random init: jax.random values differ in ulps
+    across backends (erfinv lowering), so builder-backed zoo entries
+    (downloader/downloader.py materialize path) fill the init-shaped tree
+    from a numpy rng instead — one draw sequence over sorted flattened keys,
+    he-normal for kernels, identity for BN — giving a bit-identical
+    variables.npz (and hence sha256) on CPU and TPU."""
+    import jax
+
+    # eval_shape: leaf shapes only, no actual random generation
+    variables = jax.eval_shape(net.init, jax.random.PRNGKey(0))
+
+    def walk_shapes(tree, prefix=""):
+        for k, v in tree.items():
+            key = f"{prefix}{_SEP}{k}" if prefix else str(k)
+            if isinstance(v, dict):
+                yield from walk_shapes(v, key)
+            else:
+                yield key, tuple(v.shape)
+
+    flat = dict(walk_shapes(variables))
+    rng = np.random.default_rng(seed)
+    out = {}
+    for key in sorted(flat):
+        shape = flat[key]
+        leaf = key.rsplit(_SEP, 1)[-1]
+        if leaf == "kernel":
+            fan_in = int(np.prod(shape[:-1]))
+            out[key] = (
+                rng.standard_normal(shape) * np.sqrt(2.0 / max(1, fan_in))
+            ).astype(np.float32)
+        elif leaf in ("scale", "var"):
+            out[key] = np.ones(shape, np.float32)
+        else:  # bias / mean
+            out[key] = np.zeros(shape, np.float32)
+    tree = _unflatten_tree(out)
+    tree.setdefault("params", {})
+    tree.setdefault("state", {})
+    return tree
+
+
 class NetworkBundle:
     """A Network together with its trained variables — the unit a model
     stage holds and persists (the reference's serialized CNTK model bytes,
@@ -515,6 +618,17 @@ class NetworkBundle:
     def __init__(self, network: Network, variables: dict):
         self.network = network
         self.variables = variables
+        self._dev_vars = None
+
+    def device_variables(self):
+        """Weights as device-resident arrays, uploaded once per bundle — a
+        ResNet-50's ~100MB of params re-crossing the host->HBM link on every
+        transform call would dominate small-batch inference."""
+        if self._dev_vars is None:
+            import jax
+
+            self._dev_vars = jax.device_put(self.variables)
+        return self._dev_vars
 
     def save_to_dir(self, path: str) -> None:
         self.network.save_to_dir(path, self.variables)

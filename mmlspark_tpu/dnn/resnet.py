@@ -80,6 +80,81 @@ def resnet20_cifar(num_classes: int = 10, compute_dtype: str = "float32") -> Net
     return resnet_cifar(20, num_classes, compute_dtype=compute_dtype)
 
 
+def _bottleneck_block(filters: int, stride: int = 1, project: bool = False,
+                      expansion: int = 4) -> dict:
+    """1x1 reduce -> 3x3 -> 1x1 expand bottleneck (He et al. ResNet-50/101/152).
+    The 3 matmul-shaped convs are exactly what the MXU wants: the 1x1 convs
+    lower to plain (N*H*W, Cin) x (Cin, Cout) matmuls."""
+    out = filters * expansion
+    body = (
+        _bn_relu_conv(filters, 1, kernel=1)
+        + _bn_relu_conv(filters, stride, kernel=3)
+        + [
+            {"kind": "conv", "filters": out, "kernel": 1, "stride": 1,
+             "use_bias": False},
+            {"kind": "batchnorm"},
+        ]
+    )
+    block: dict = {"kind": "residual", "body": body}
+    if project:
+        block["shortcut"] = [
+            {"kind": "conv", "filters": out, "kernel": 1, "stride": stride,
+             "use_bias": False},
+            {"kind": "batchnorm"},
+        ]
+    return block
+
+
+def resnet_imagenet(
+    depth: int = 50,
+    num_classes: int = 1000,
+    input_shape: Sequence[int] = (224, 224, 3),
+    compute_dtype: str = "float32",
+) -> Network:
+    """ImageNet-style bottleneck ResNet (50/101/152): 7x7/2 stem + 3x3/2
+    maxpool, 4 stages of bottleneck blocks at 64/128/256/512 base filters
+    (x4 expansion), global average pool, dense head.
+
+    The flagship transfer-learning network — the role CNTK ResNet-50 plays
+    for the reference (ModelDownloader.scala:209-267 downloadByName
+    "ResNet50"; consumed by ImageFeaturizer.scala:129-177)."""
+    stages = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+    if depth not in stages:
+        raise ValueError(f"ImageNet ResNet depth must be one of {sorted(stages)}")
+    spec: List[dict] = [
+        {"kind": "conv", "name": "stem", "filters": 64, "kernel": 7, "stride": 2,
+         "use_bias": False},
+        {"kind": "batchnorm", "name": "stem_bn"},
+        {"kind": "relu", "name": "stem_relu"},
+        {"kind": "max_pool", "name": "stem_pool", "size": 3, "stride": 2,
+         "padding": "SAME"},
+    ]
+    for stage, (filters, n_blocks) in enumerate(
+        zip((64, 128, 256, 512), stages[depth])
+    ):
+        for block in range(n_blocks):
+            stride = 2 if (stage > 0 and block == 0) else 1
+            cfg = _bottleneck_block(filters, stride, project=block == 0)
+            cfg["name"] = f"stage{stage + 1}_block{block + 1}"
+            spec.append(cfg)
+            spec.append(
+                {"kind": "relu", "name": f"stage{stage + 1}_relu{block + 1}"}
+            )
+    spec += [
+        {"kind": "global_avg_pool", "name": "pool"},
+        {"kind": "dense", "name": "logits", "units": num_classes},
+    ]
+    return Network(spec, input_shape, compute_dtype)
+
+
+def resnet50(
+    num_classes: int = 1000,
+    input_shape: Sequence[int] = (224, 224, 3),
+    compute_dtype: str = "float32",
+) -> Network:
+    return resnet_imagenet(50, num_classes, input_shape, compute_dtype)
+
+
 def resnet_mini(num_classes: int = 10, input_shape: Sequence[int] = (8, 8, 3)) -> Network:
     """Tiny 2-block ResNet for fast CPU tests."""
     spec = [

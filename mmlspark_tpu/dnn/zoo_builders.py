@@ -1,0 +1,31 @@
+"""Deterministic factories for builder-backed zoo entries.
+
+Each factory returns a NetworkBundle built from a fixed numpy seed
+(network.deterministic_variables), so the downloader can rebuild the exact
+bytes — and verify the MANIFEST-pinned sha256 — on any backend. This stands
+in for the reference's CDN-hosted CNTK checkpoints
+(ModelDownloader.scala:209-267): zero-egress builds can't download, so the
+zoo pins recipes instead of blobs.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from mmlspark_tpu.dnn.network import NetworkBundle, deterministic_variables
+from mmlspark_tpu.dnn.resnet import resnet50
+
+
+def resnet50_random(
+    num_classes: int = 1000,
+    input_shape: Sequence[int] = (224, 224, 3),
+    seed: int = 0,
+) -> NetworkBundle:
+    """Randomly-initialized ResNet-50 (ImageNet geometry, ~25.5M params).
+
+    Random weights are fine for the featurization/serving benches and the
+    transfer-learning plumbing (random conv features are still a usable
+    embedding); a trained checkpoint would drop in through the same entry.
+    """
+    net = resnet50(num_classes=num_classes, input_shape=tuple(input_shape))
+    return NetworkBundle(net, deterministic_variables(net, seed))

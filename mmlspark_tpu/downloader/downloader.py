@@ -28,6 +28,37 @@ log = get_logger("mmlspark_tpu.downloader")
 _MANIFEST = "MANIFEST.json"
 
 
+def _upsert_manifest(repo_dir: str, schema: "ModelSchema") -> None:
+    """Replace-or-append `schema` in repo_dir/MANIFEST.json (keyed by name)."""
+    manifest = os.path.join(repo_dir, _MANIFEST)
+    entries = []
+    if os.path.exists(manifest):
+        with open(manifest) as f:
+            entries = [e for e in json.load(f) if e.get("name") != schema.name]
+    entries.append(schema.to_dict())
+    os.makedirs(repo_dir, exist_ok=True)
+    with open(manifest, "w") as f:
+        json.dump(entries, f, indent=1)
+
+
+def _materialize_builder(builder: Dict, dest: str) -> None:
+    """Rebuild a builder-backed model directory from its pinned recipe.
+    Factories are restricted to this package so a manifest can't import
+    arbitrary code."""
+    factory = builder.get("factory", "")
+    mod_name, _, fn_name = factory.partition(":")
+    if not mod_name.startswith("mmlspark_tpu.") or not fn_name:
+        raise ValueError(
+            f"builder factory must be 'mmlspark_tpu.<module>:<fn>', got "
+            f"{factory!r}"
+        )
+    import importlib
+
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+    bundle = fn(**builder.get("kwargs", {}))
+    bundle.save_to_dir(dest)
+
+
 def default_zoo_dir() -> str:
     """The committed zoo, shipped as package data (tools/make_zoo.py
     populates it) — present in both editable and wheel installs."""
@@ -63,7 +94,7 @@ class ModelDownloader:
 
         def resolve(d: Dict) -> ModelSchema:
             s = ModelSchema.from_dict(d)
-            if "://" not in s.uri and not os.path.isabs(s.uri):
+            if s.uri and "://" not in s.uri and not os.path.isabs(s.uri):
                 s = s.with_uri(os.path.join(repo, s.uri))
             return s
 
@@ -91,14 +122,29 @@ class ModelDownloader:
             except ValueError:
                 log.info("local copy of %s stale; re-fetching", schema.name)
                 shutil.rmtree(dest)
-        src = schema.local_path()
-        if not os.path.isdir(src):
-            raise FileNotFoundError(f"model source {src!r} is not a directory")
-        shutil.copytree(src, dest)
+        if schema.builder:
+            _materialize_builder(schema.builder, dest)
+        else:
+            src = schema.local_path()
+            if not os.path.isdir(src):
+                raise FileNotFoundError(
+                    f"model source {src!r} is not a directory"
+                )
+            shutil.copytree(src, dest)
         try:
             schema.assert_matching_hash(dest)
-        except ValueError:
+        except ValueError as e:
             shutil.rmtree(dest, ignore_errors=True)
+            if schema.builder:
+                import numpy as _np
+
+                raise ValueError(
+                    f"builder-backed model {schema.name!r} rebuilt with a "
+                    f"different hash (numpy {_np.__version__}): the pinned "
+                    "recipe draws from np.random.Generator, whose stream can "
+                    "shift across numpy releases — re-run tools/make_zoo.py "
+                    "to re-pin the manifest"
+                ) from e
             raise
         local = schema.with_uri(dest)
         self._record(local)
@@ -166,24 +212,51 @@ class ModelDownloader:
             layer_names=list(layer_names or []),
             extra=dict(extra or {}),
         )
-        manifest = os.path.join(repo_dir, _MANIFEST)
-        entries = []
-        if os.path.exists(manifest):
-            with open(manifest) as f:
-                entries = [e for e in json.load(f) if e.get("name") != name]
-        entries.append(schema.to_dict())
-        with open(manifest, "w") as f:
-            json.dump(entries, f, indent=1)
+        _upsert_manifest(repo_dir, schema)
+        return schema
+
+    @staticmethod
+    def publish_builder(
+        repo_dir: str,
+        *,
+        name: str,
+        dataset: str,
+        builder: Dict,
+        model_type: str = "image",
+        input_node: int = 0,
+        layer_names: Optional[List[str]] = None,
+        extra: Optional[Dict] = None,
+    ) -> ModelSchema:
+        """MANIFEST a builder-backed entry: materialize once into a scratch
+        dir to pin the hash/size, but commit only the recipe — the weights
+        rebuild deterministically on first download_model."""
+        import tempfile
+
+        import numpy as _np
+
+        with tempfile.TemporaryDirectory() as tmp:
+            dest = os.path.join(tmp, "model")
+            _materialize_builder(builder, dest)
+            digest = hash_model_dir(dest)
+            size = model_dir_size(dest)
+        extra = dict(extra or {})
+        # provenance for hash-mismatch debugging: which numpy stream pinned it
+        extra.setdefault("pinned_with_numpy", _np.__version__)
+        schema = ModelSchema(
+            name=name,
+            dataset=dataset,
+            model_type=model_type,
+            uri="",
+            hash=digest,
+            size=size,
+            input_node=input_node,
+            num_layers=len(layer_names or []),
+            layer_names=list(layer_names or []),
+            extra=extra,
+            builder=dict(builder),
+        )
+        _upsert_manifest(repo_dir, schema)
         return schema
 
     def _record(self, schema: ModelSchema) -> None:
-        manifest = os.path.join(self.local_path, _MANIFEST)
-        entries = []
-        if os.path.exists(manifest):
-            with open(manifest) as f:
-                entries = [
-                    e for e in json.load(f) if e.get("name") != schema.name
-                ]
-        entries.append(schema.to_dict())
-        with open(manifest, "w") as f:
-            json.dump(entries, f, indent=1)
+        _upsert_manifest(self.local_path, schema)

@@ -59,6 +59,12 @@ class ModelSchema:
     num_layers: int = 0
     layer_names: List[str] = dataclasses.field(default_factory=list)
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Builder-backed entry: instead of shipping tens-of-MB weight files in
+    # the repo, the manifest pins {"factory": "module:fn", "kwargs": {...}}
+    # plus the sha256 of the deterministically materialized directory; the
+    # downloader rebuilds it on first fetch and verifies the hash (the same
+    # integrity contract as a file copy — Schema.scala assertMatchingHash).
+    builder: Optional[Dict[str, Any]] = None
 
     @property
     def filename(self) -> str:
@@ -98,6 +104,7 @@ class ModelSchema:
             "numLayers": self.num_layers,
             "layerNames": list(self.layer_names),
             **({"extra": self.extra} if self.extra else {}),
+            **({"builder": self.builder} if self.builder else {}),
         }
 
     @classmethod
@@ -113,6 +120,7 @@ class ModelSchema:
             num_layers=int(d.get("numLayers", d.get("num_layers", 0))),
             layer_names=list(d.get("layerNames", d.get("layer_names", []))),
             extra=dict(d.get("extra", {})),
+            builder=d.get("builder"),
         )
 
     def dump(self, path: str) -> None:

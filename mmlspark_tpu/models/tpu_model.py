@@ -20,7 +20,6 @@ TPU-native design choices vs the reference:
 
 from __future__ import annotations
 
-import functools
 from typing import List, Optional
 
 import numpy as np
@@ -30,6 +29,27 @@ from mmlspark_tpu.core.params import ComplexParam, Param, TypeConverters, Wrappa
 from mmlspark_tpu.core.pipeline import Model
 from mmlspark_tpu.dnn.network import Network, NetworkBundle
 from mmlspark_tpu.parallel.mesh import batch_sharding, pad_to_multiple, replicated_sharding
+
+
+_FWD_CACHE: dict = {}
+
+
+def _compiled_forward(net: Network):
+    """Process-wide jit cache keyed by (spec, input_shape, dtype) so every
+    TPUModel instance wrapping the same network shares one compiled program."""
+    key = (str(net.spec), str(net.input_shape), net.compute_dtype)
+    fn = _FWD_CACHE.get(key)
+    if fn is None:
+        import jax
+
+        def fwd(variables, x):
+            return net.apply(variables, x)
+
+        fn = jax.jit(fwd)
+        if len(_FWD_CACHE) >= 32:  # bound retained traces
+            _FWD_CACHE.pop(next(iter(_FWD_CACHE)))
+        _FWD_CACHE[key] = fn
+    return fn
 
 
 def extract_feature_matrix(col, in_shape, col_name: str = "features") -> np.ndarray:
@@ -185,43 +205,25 @@ class TPUModel(Model, Wrappable):
             net = net.truncate_at(self.get(self.output_layer))
         return net
 
-    @functools.lru_cache(maxsize=8)
-    def _compiled(self, spec_key: str, batch: int):
-        """One jit program per (truncated-spec, batch-size)."""
-        import jax
-
-        net = self._network_for_eval()
-
-        def fwd(variables, x):
-            return net.apply(variables, x)
-
-        return jax.jit(fwd)
-
-    def __hash__(self):  # lru_cache on methods needs a hashable self
-        return id(self)
-
-    def __eq__(self, other):
-        return self is other
-
     def _eval_batches(self, x: np.ndarray) -> np.ndarray:
         import jax
 
         bundle = self.get_model()
-        net = self._network_for_eval()
         bs = self.get(self.mini_batch_size)
-        spec_key = str(net.spec)
-        fn = self._compiled(spec_key, bs)
+        fn = _compiled_forward(self._network_for_eval())
 
-        variables = bundle.variables
         if self.get(self.use_mesh):
             from mmlspark_tpu.parallel.mesh import data_parallel_mesh
 
             mesh = data_parallel_mesh()
             n_data = mesh.shape["data"]
             bs = max(bs, n_data) // n_data * n_data
-            variables = jax.device_put(variables, replicated_sharding(mesh))
+            variables = jax.device_put(
+                bundle.variables, replicated_sharding(mesh)
+            )
             in_shard = batch_sharding(mesh, ndim=x.ndim)
         else:
+            variables = bundle.device_variables()  # uploaded once per bundle
             in_shard = None
 
         import jax.numpy as jnp
@@ -267,7 +269,7 @@ class TPUModel(Model, Wrappable):
                 # defeat the HBM bound the spill exists to enforce
                 in_flight = [w for w in in_flight if w is not y0]
         if not results and not spilled:
-            out_dim = net.out_shape()
+            out_dim = self._network_for_eval().out_shape()
             return np.zeros((0,) + tuple(out_dim), np.float32)
         trimmed = [y[:real] for y, real in results]
         full = trimmed[0] if len(trimmed) == 1 else jnp.concatenate(trimmed, axis=0)

@@ -149,3 +149,63 @@ def test_flatten_batch_mismatched_sizes_raises():
     )
     with pytest.raises(ValueError):
         FlattenBatch().transform(df)
+
+
+def test_resnet50_structure_and_flops():
+    """Bottleneck ResNet-50 geometry: 25.557M params at 1000 classes, ~8.2
+    GFLOPs forward (2x the published 4.1 GMACs), 2048-dim pool features —
+    the zoo flagship (reference ModelDownloader.scala:209-267 ResNet50)."""
+    from mmlspark_tpu.dnn import resnet50
+
+    net = resnet50(num_classes=1000)
+    assert net.out_shape() == (1000,)
+    assert abs(net.flops_per_example() / 1e9 - 8.18) < 0.1
+    pooled = net.truncate_at("pool")
+    assert pooled.out_shape() == (2048,)
+
+    # small-geometry variant runs forward on CPU quickly
+    small = resnet50(num_classes=7, input_shape=(64, 64, 3))
+    v = small.init(jax.random.PRNGKey(0))
+    y = small.apply(v, np.zeros((2, 64, 64, 3), np.float32))
+    assert np.asarray(y).shape == (2, 7)
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_resnet50_param_count():
+    from mmlspark_tpu.dnn import resnet50
+    from mmlspark_tpu.dnn.network import deterministic_variables
+
+    net = resnet50(num_classes=1000)
+    v = deterministic_variables(net, 0)
+    n_params = sum(
+        int(np.prod(np.asarray(a).shape))
+        for a in jax.tree_util.tree_leaves(v["params"])
+    )
+    assert n_params == 25_557_032  # the canonical ResNet-50 count
+
+
+def test_same_padded_pooling():
+    """SAME-padded max_pool (the ImageNet stem's 3x3/2 pool) preserves
+    ceil-div output shape."""
+    net = Network(
+        [{"kind": "max_pool", "name": "p", "size": 3, "stride": 2,
+          "padding": "SAME"}],
+        input_shape=(7, 7, 2),
+    )
+    assert net.out_shape() == (4, 4, 2)
+    v = net.init(jax.random.PRNGKey(0))
+    y = net.apply(v, np.arange(2 * 7 * 7 * 2, dtype=np.float32).reshape(2, 7, 7, 2))
+    assert np.asarray(y).shape == (2, 4, 4, 2)
+
+
+def test_same_padded_avg_pool_edge_counts():
+    """SAME avg_pool divides edge windows by the real element count, not
+    k*k (count_include_pad=False): an all-ones input must pool to all ones."""
+    net = Network(
+        [{"kind": "avg_pool", "name": "p", "size": 3, "stride": 2,
+          "padding": "SAME"}],
+        input_shape=(7, 7, 1),
+    )
+    v = net.init(jax.random.PRNGKey(0))
+    y = np.asarray(net.apply(v, np.ones((1, 7, 7, 1), np.float32)))
+    np.testing.assert_allclose(y, 1.0, rtol=1e-6)

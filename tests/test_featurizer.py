@@ -310,3 +310,32 @@ class TestImageLIME:
         informative = np.array([overlaps(c, P1) for c in sp.clusters])
         # patch-1 superpixels should carry the largest positive weights
         assert informative[np.argmax(w)]
+
+
+class TestBuilderZoo:
+    """Builder-backed zoo entries: the MANIFEST pins a deterministic recipe
+    + sha256 instead of committed weights (downloader.py _materialize_builder)."""
+
+    def test_resnet50_manifest_entry(self):
+        d = ModelDownloader("/tmp/_unused_zoo_listing")
+        entries = {s.name: s for s in d.remote_models()}
+        assert "ResNet50" in entries
+        s = entries["ResNet50"]
+        assert s.builder and s.builder["factory"].startswith("mmlspark_tpu.")
+        assert s.layer_names[0] == "logits"
+
+    def test_builder_materialize_and_verify(self, tmp_path):
+        d = ModelDownloader(str(tmp_path / "local"))
+        schema = d.download_by_name("ResNet50")  # materializes + hash-checks
+        bundle = d.load_bundle(schema)
+        assert bundle.network.input_shape == (224, 224, 3)
+        assert bundle.network.truncate_at("pool").out_shape() == (2048,)
+        # re-download short-circuits on the verified local copy
+        again = d.download_by_name("ResNet50")
+        assert again.uri == schema.uri
+
+    def test_builder_factory_restricted(self, tmp_path):
+        from mmlspark_tpu.downloader.downloader import _materialize_builder
+
+        with pytest.raises(ValueError, match="factory must be"):
+            _materialize_builder({"factory": "os:system"}, str(tmp_path / "x"))

@@ -109,6 +109,28 @@ def main() -> None:
     print(f"published {schema.name}_{schema.dataset}: hash={schema.hash[:12]}... "
           f"size={schema.size}B")
 
+    # ResNet-50 (ImageNet geometry, ~25.5M params / ~100MB of weights):
+    # committed as a builder RECIPE, not a blob — the downloader rebuilds it
+    # deterministically and checks the hash pinned here (the reference's
+    # downloadByName("ResNet50") flow, ModelDownloader.scala:209-267).
+    schema = ModelDownloader.publish_builder(
+        repo_dir,
+        name="ResNet50",
+        dataset="ImageNet",
+        builder={
+            "factory": "mmlspark_tpu.dnn.zoo_builders:resnet50_random",
+            "kwargs": {"num_classes": 1000, "seed": 0},
+        },
+        model_type="image",
+        input_node=0,
+        layer_names=["logits", "pool", "stage4_relu3", "stage4_relu2",
+                     "stage4_relu1"],
+        extra={"weights": "random-init (deterministic seed 0)",
+               "input_shape": [224, 224, 3]},
+    )
+    print(f"published {schema.name}_{schema.dataset}: hash={schema.hash[:12]}... "
+          f"size={schema.size}B (builder-backed)")
+
 
 if __name__ == "__main__":
     main()
