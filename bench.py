@@ -35,11 +35,44 @@ import time
 import numpy as np
 
 V100_CNTK_IMGS_PER_SEC = 6000.0  # documented estimate, see BASELINE.md
-CPU_LIGHTGBM_ADULT_SECONDS = 3.0  # documented estimate, see BASELINE.md
 
 N_IMAGES = 16384
 BATCH = 8192
 REPEATS = 5  # median-of-5 (round-3 verdict: best-of-3 hid tunnel variance)
+
+# bf16 peak FLOP/s by device kind — the MFU denominator. Sources: public
+# TPU spec sheets (v5e 197, v4 275, v5p 459, v6e 918 TFLOP/s bf16).
+_PEAK_BF16 = {
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def peak_flops() -> float:
+    """Best-effort bf16 peak for the attached chip; 0 when unknown (MFU
+    lines are then omitted rather than wrong)."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in _PEAK_BF16.items():
+        if key in kind:
+            return peak
+    return 0.0
+
+
+def mfu(imgs_per_sec: float, flops_per_img: float) -> float:
+    """Model FLOPs utilization in percent, or -1 when peak is unknown."""
+    peak = peak_flops()
+    if peak <= 0:
+        return -1.0
+    return round(100.0 * imgs_per_sec * flops_per_img / peak, 2)
 
 
 def bench_cifar():
@@ -138,14 +171,64 @@ def make_adult_like(n: int = 48842, seed: int = 0):
     return x, y, cat_idx
 
 
-def bench_gbdt():
-    from mmlspark_tpu.core.dataframe import DataFrame, DataType
+def _auc(p: np.ndarray, yt: np.ndarray) -> float:
+    order = np.argsort(p)
+    ranks = np.empty(len(p))
+    ranks[order] = np.arange(1, len(p) + 1)
+    pos = yt > 0
+    return float(
+        (ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2)
+        / (pos.sum() * (~pos).sum())
+    )
+
+
+def _sklearn_gbdt_bar(x_train, y_train, x_test, y_test, cat_idx):
+    """MEASURED CPU bar (round-4 verdict item 1: the 3.0s constant was a
+    guess nobody timed): sklearn HistGradientBoostingClassifier — the same
+    histogram-GBDT family — fit on the identical train matrix, timed in
+    this very run on this very machine."""
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    cat_mask = np.zeros(x_train.shape[1], bool)
+    cat_mask[list(cat_idx)] = True
+    clf = HistGradientBoostingClassifier(
+        max_iter=100, max_leaf_nodes=31, max_bins=255,
+        categorical_features=cat_mask,
+        early_stopping=False,
+    )
+    t0 = time.time()
+    clf.fit(x_train, y_train)
+    fit_seconds = time.time() - t0
+    auc = _auc(clf.predict_proba(x_test)[:, 1], y_test)
+    return fit_seconds, auc
+
+
+def make_higgs_like(n: int = 1_000_000, f: int = 30, seed: int = 0):
+    """1M x 30 synthetic binary task (6 integer-coded categoricals) — the
+    at-scale GBDT config (reference speed pitch is Higgs-scale,
+    docs/lightgbm.md:17-21; round-4 verdict item 1 asked for >=1M rows)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f))
+    for j in range(f - 6, f):
+        x[:, j] = rng.integers(0, 20, n)
+    logit = (
+        0.8 * x[:, 0] - 0.5 * x[:, 1] + 0.3 * x[:, 2] * x[:, 3]
+        + 0.4 * (x[:, f - 1] % 4 == 1) - 0.2
+    )
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float64)
+    cat_idx = list(range(f - 6, f))
+    return x, y, cat_idx
+
+
+def _bench_gbdt_config(x, y, cat_idx, train_frac: float = 0.8):
+    """Fit ours + the measured sklearn bar on one dataset; returns a dict of
+    fit seconds / speedup / AUCs."""
+    from mmlspark_tpu.core.dataframe import DataFrame
     from mmlspark_tpu.gbdt import LightGBMClassifier
 
-    x, y, cat_idx = make_adult_like()
     n = len(y)
     holdout = np.zeros(n, bool)
-    holdout[int(n * 0.8):] = True
+    holdout[int(n * train_frac):] = True
     df = DataFrame.from_dict({"features": x[~holdout], "label": y[~holdout]})
 
     def fit_once():
@@ -165,15 +248,83 @@ def bench_gbdt():
 
     test = DataFrame.from_dict({"features": x[holdout]})
     p = model.transform(test)["probability"][:, 1]
-    yt = y[holdout]
-    order = np.argsort(p)
-    ranks = np.empty(n - int(n * 0.8))
-    ranks[order] = np.arange(1, len(p) + 1)
-    pos = yt > 0
-    auc = (ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2) / (
-        pos.sum() * (~pos).sum()
+    auc = _auc(p, y[holdout])
+
+    cpu_seconds, cpu_auc = _sklearn_gbdt_bar(
+        x[~holdout], y[~holdout], x[holdout], y[holdout], cat_idx
     )
-    return fit_seconds, float(auc)
+    return {
+        "fit_seconds": round(fit_seconds, 2),
+        "cpu_sklearn_seconds": round(cpu_seconds, 2),
+        "fit_vs_measured_cpu": round(cpu_seconds / fit_seconds, 3),
+        "auc": round(auc, 4),
+        "cpu_auc": round(cpu_auc, 4),
+    }
+
+
+def bench_gbdt():
+    x, y, cat_idx = make_adult_like()
+    return _bench_gbdt_config(x, y, cat_idx)
+
+
+def bench_gbdt_1m():
+    x, y, cat_idx = make_higgs_like()
+    return _bench_gbdt_config(x, y, cat_idx)
+
+
+def bench_resnet50():
+    """ResNet-50 (zoo flagship, ~25.5M params, 8.2 GFLOPs/img) featurization
+    throughput through TPUModel, truncated at the 2048-d pool layer — the
+    transfer-learning path the reference drives with downloadByName
+    ("ResNet50") (ModelDownloader.scala:209-267). Returns (e2e imgs/sec,
+    device-resident imgs/sec, flops_per_img). Device-resident feeds the MFU
+    line: at this model size the chip should actually be working."""
+    import jax
+
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.dnn.zoo_builders import resnet50_random
+    from mmlspark_tpu.dnn.network import NetworkBundle
+    from mmlspark_tpu.models import TPUModel
+
+    n_images, batch = 1024, 128
+    bundle = resnet50_random()  # deterministic rebuild, no 100MB blob in-repo
+    net = bundle.network.truncate_at("pool")
+    net.compute_dtype = "bfloat16"
+    headless = NetworkBundle(net, bundle.variables)
+    flops_per_img = net.flops_per_example()
+
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(
+        0, 256, size=(n_images, 224 * 224 * 3), dtype=np.uint8
+    )
+    df = DataFrame.from_dict({"images": imgs})
+    model = TPUModel(headless, input_col="images", output_col="features",
+                     mini_batch_size=batch)
+    model.transform(df.limit(batch))  # compile + warmup
+
+    e2e = []
+    for _ in range(3):
+        t0 = time.time()
+        out = model.transform(df)
+        e2e.append(n_images / (time.time() - t0))
+    assert out["features"].shape == (n_images, 2048)
+
+    from mmlspark_tpu.models.tpu_model import _compiled_forward
+
+    fn = _compiled_forward(net)
+    variables = headless.device_variables()
+    x_dev = [
+        jax.device_put(imgs[i: i + batch].reshape(-1, 224, 224, 3))
+        for i in range(0, n_images, batch)
+    ]
+    jax.block_until_ready(fn(variables, x_dev[0]))  # warm
+    resident = []
+    for _ in range(REPEATS):
+        t0 = time.time()
+        ys = [fn(variables, xd) for xd in x_dev]
+        jax.block_until_ready(ys)
+        resident.append(n_images / (time.time() - t0))
+    return float(np.median(e2e)), float(np.median(resident)), flops_per_img
 
 
 def bench_serving():
@@ -314,14 +465,42 @@ def bench_distributed_serving():
         model_p50, model_p99 = run_load(
             srv, "model", {"img": img}, n_requests=15
         )
-    return triv_p50, triv_p99, model_p50, model_p99
+        decomp = srv.workers[0].stage_summary()  # queue/lock/handler split
+    return triv_p50, triv_p99, model_p50, model_p99, decomp
 
 
 def main() -> int:
+    from mmlspark_tpu.dnn import resnet20_cifar
+
     imgs_per_sec, imgs_per_sec_resident = bench_cifar()
-    gbdt_seconds, gbdt_auc = bench_gbdt()
+    r50_e2e, r50_resident, r50_flops = bench_resnet50()
+    gbdt_adult = bench_gbdt()
+    gbdt_1m = bench_gbdt_1m()
     p50, p99 = bench_serving()
-    d_p50, d_p99, m_p50, m_p99 = bench_distributed_serving()
+    d_p50, d_p99, m_p50, m_p99, m_decomp = bench_distributed_serving()
+
+    r20_flops = resnet20_cifar().flops_per_example()
+    extras = {
+        "cifar_device_resident_imgs_per_sec": round(imgs_per_sec_resident, 1),
+        "resnet50_featurize_imgs_per_sec": round(r50_e2e, 1),
+        "resnet50_device_resident_imgs_per_sec": round(r50_resident, 1),
+        "serving_p50_ms": round(p50, 3),
+        "serving_p99_ms": round(p99, 3),
+        "serving_pool8_p50_ms": round(d_p50, 3),
+        "serving_pool8_p99_ms": round(d_p99, 3),
+        "serving_resnet20_p50_ms": round(m_p50, 3),
+        "serving_resnet20_p99_ms": round(m_p99, 3),
+        "serving_resnet20_stage_decomp": m_decomp,
+    }
+    # MFU lines omitted (not -1) on unknown device kinds, per peak_flops
+    if peak_flops() > 0:
+        extras["cifar_resident_mfu_percent"] = mfu(
+            imgs_per_sec_resident, r20_flops
+        )
+        extras["resnet50_resident_mfu_percent"] = mfu(r50_resident, r50_flops)
+    for name, cfg in (("gbdt_adult", gbdt_adult), ("gbdt_1m", gbdt_1m)):
+        for k, v in cfg.items():
+            extras[f"{name}_{k}"] = v
 
     print(
         json.dumps(
@@ -330,22 +509,7 @@ def main() -> int:
                 "value": round(imgs_per_sec, 1),
                 "unit": "imgs/sec/chip",
                 "vs_baseline": round(imgs_per_sec / V100_CNTK_IMGS_PER_SEC, 3),
-                "extras": {
-                    "cifar_device_resident_imgs_per_sec": round(
-                        imgs_per_sec_resident, 1
-                    ),
-                    "gbdt_adult_fit_seconds": round(gbdt_seconds, 2),
-                    "gbdt_adult_fit_vs_cpu_baseline": round(
-                        CPU_LIGHTGBM_ADULT_SECONDS / gbdt_seconds, 3
-                    ),
-                    "gbdt_adult_auc": round(gbdt_auc, 4),
-                    "serving_p50_ms": round(p50, 3),
-                    "serving_p99_ms": round(p99, 3),
-                    "serving_pool8_p50_ms": round(d_p50, 3),
-                    "serving_pool8_p99_ms": round(d_p99, 3),
-                    "serving_resnet20_p50_ms": round(m_p50, 3),
-                    "serving_resnet20_p99_ms": round(m_p99, 3),
-                },
+                "extras": extras,
             }
         )
     )

@@ -114,6 +114,20 @@ class DefaultHyperparams:
         elif name == "TPULearner":
             builder.add_hyperparam(estimator, "learning_rate", DoubleRangeHyperParam(0.001, 0.3))
             builder.add_hyperparam(estimator, "epochs", DiscreteHyperParam([10, 25, 50]))
+        elif name in ("RandomForestClassifier", "RandomForestRegressor"):
+            # DefaultHyperparams.scala:55-63 (RandomForestClassifier ranges)
+            builder.add_hyperparam(estimator, "max_bins", IntRangeHyperParam(16, 32))
+            builder.add_hyperparam(estimator, "max_depth", IntRangeHyperParam(2, 5))
+            builder.add_hyperparam(estimator, "min_info_gain", DoubleRangeHyperParam(0.0, 0.5))
+            builder.add_hyperparam(estimator, "min_instances_per_node", IntRangeHyperParam(1, 8))
+            builder.add_hyperparam(estimator, "num_trees", IntRangeHyperParam(10, 30))
+            builder.add_hyperparam(estimator, "subsampling_rate", DoubleRangeHyperParam(0.1, 1.0))
+        elif name in ("DecisionTreeClassifier", "DecisionTreeRegressor"):
+            # DefaultHyperparams.scala:28-35 (DecisionTreeClassifier ranges)
+            builder.add_hyperparam(estimator, "max_bins", IntRangeHyperParam(16, 32))
+            builder.add_hyperparam(estimator, "max_depth", IntRangeHyperParam(2, 5))
+            builder.add_hyperparam(estimator, "min_info_gain", DoubleRangeHyperParam(0.0, 0.5))
+            builder.add_hyperparam(estimator, "min_instances_per_node", IntRangeHyperParam(1, 8))
         else:
             raise ValueError(f"no default hyperparams for {name}")
         return builder.build()

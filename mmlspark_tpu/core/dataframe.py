@@ -129,8 +129,18 @@ class Column:
         if dtype is None:
             dtype = _infer_type(values)
         if dtype == DataType.VECTOR and values.ndim != 2:
-            # rows of array-likes -> dense 2D
-            values = np.stack([np.asarray(v, dtype=np.float64) for v in values])
+            # rows of array-likes -> dense 2D; ragged rows (legal for Spark
+            # vector columns — e.g. per-image LIME weights with differing
+            # superpixel counts) stay as an object array of 1-D vectors.
+            # Element conversion errors still raise — only raggedness is
+            # tolerated.
+            rows = [np.asarray(v, dtype=np.float64) for v in values]
+            if len({r.shape for r in rows}) <= 1:
+                values = np.stack(rows) if rows else values
+            else:
+                ragged = np.empty(len(rows), object)
+                ragged[:] = rows
+                values = ragged
         self.values = values
         self.dtype = dtype
         self.metadata = metadata or {}

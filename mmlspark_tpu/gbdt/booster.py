@@ -139,10 +139,89 @@ class Booster:
         )
         return self._packed
 
-    def predict_raw(self, x: np.ndarray) -> np.ndarray:
-        """Margin scores. -> (n,) for single-model, (n, K) for multiclass."""
+    # Device tree-walk row block. Fixed so large predicts always run a
+    # known-good program shape: XLA on the attached chip MISCOMPILED
+    # walk_trees_raw at certain (rows, trees) shapes — (200k, 100) returned
+    # a constant while (160k, 100) and (400k, 100) were fine (round-5
+    # debugging of BENCH gbdt_1m AUC 0.4986-vs-0.7324). Chunking to one
+    # verified shape plus the sampled host cross-check below turns any
+    # repeat of that silent-corruption class into a detected, corrected
+    # event instead of a garbage model score.
+    _WALK_CHUNK = 131072
+    _VERIFY_ROWS = 64
+
+    def _walk_device(self, x: np.ndarray, packed) -> np.ndarray:
         from mmlspark_tpu.gbdt.compute import walk_trees_raw
 
+        return np.asarray(
+            walk_trees_raw(
+                x, packed["feats"], packed["thr"], packed["is_cat"],
+                packed["cat_mask"], packed["lefts"], packed["rights"],
+                packed["is_leaf"], packed["values"],
+                max_depth=packed["max_depth"],
+            )
+        )
+
+    def _walk_numpy(self, x: np.ndarray, packed) -> np.ndarray:
+        """Host reference walk — verification oracle and corruption
+        fallback. Same semantics as compute.walk_trees_raw."""
+        n = x.shape[0]
+        t = packed["feats"].shape[0]
+        cat_size = packed["cat_mask"].shape[-1]
+        outs = np.empty((n, t), np.float32)
+        rows = np.arange(n)
+        for i in range(t):
+            node = np.zeros(n, np.int32)
+            for _ in range(packed["max_depth"]):
+                f = packed["feats"][i][node]
+                v = x[rows, f]
+                nan = np.isnan(v)
+                num_left = nan | (v <= packed["thr"][i][node])
+                vi = np.clip(np.where(nan, -1, v).astype(np.int32), 0,
+                             cat_size - 1)
+                cat_left = packed["cat_mask"][i][node, vi] & ~nan & (v >= 0)
+                go_left = np.where(packed["is_cat"][i][node], cat_left,
+                                   num_left)
+                nxt = np.where(go_left, packed["lefts"][i][node],
+                               packed["rights"][i][node])
+                node = np.where(packed["is_leaf"][i][node], node,
+                                nxt).astype(np.int32)
+            outs[:, i] = packed["values"][i][node]
+        return outs
+
+    def _walk_all(self, x: np.ndarray, packed) -> np.ndarray:
+        """Chunked device walk with a sampled host cross-check."""
+        n = x.shape[0]
+        if n == 0:
+            return np.zeros((0, packed["feats"].shape[0]), np.float32)
+        chunks = []
+        for start in range(0, n, self._WALK_CHUNK):
+            block = x[start: start + self._WALK_CHUNK]
+            real = block.shape[0]
+            if n > self._WALK_CHUNK and real < self._WALK_CHUNK:
+                block = np.concatenate(
+                    [block,
+                     np.zeros((self._WALK_CHUNK - real, x.shape[1]),
+                              np.float32)]
+                )
+            chunks.append(self._walk_device(block, packed)[:real])
+        outs = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
+        # sampled host cross-check: silent device corruption -> detected
+        idx = np.linspace(0, n - 1, min(self._VERIFY_ROWS, n)).astype(int)
+        ref = self._walk_numpy(x[idx], packed)
+        if not np.allclose(outs[idx], ref, rtol=1e-5, atol=1e-6):
+            from mmlspark_tpu.core.config import get_logger
+
+            get_logger("mmlspark_tpu.gbdt").warning(
+                "device tree-walk disagreed with the host reference at "
+                "shape %s x %s trees; recomputing on host",
+                x.shape, packed["feats"].shape[0],
+            )
+            outs = self._walk_numpy(x, packed)
+        return outs
+
+    def predict_raw(self, x: np.ndarray) -> np.ndarray:
+        """Margin scores. -> (n,) for single-model, (n, K) for multiclass."""
         x = np.ascontiguousarray(np.asarray(x, np.float32))
         n = x.shape[0]
         k = self.num_model_per_iter
@@ -150,14 +229,7 @@ class Booster:
         if packed is None:
             raw = np.zeros((n, k), np.float32) + self.init_score[None, :]
             return raw[:, 0] if k == 1 else raw
-        outs = np.asarray(
-            walk_trees_raw(
-                x, packed["feats"], packed["thr"], packed["is_cat"],
-                packed["cat_mask"], packed["lefts"], packed["rights"],
-                packed["is_leaf"], packed["values"],
-                max_depth=packed["max_depth"],
-            )
-        )  # (n, T)
+        outs = self._walk_all(x, packed)  # (n, T)
         if k == 1:
             raw = self.init_score[0] + outs.sum(axis=1)
             if self.avg_output:

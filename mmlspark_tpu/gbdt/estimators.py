@@ -61,6 +61,9 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
     )
     lambda_l1 = Param("lambda_l1", "L1 regularization", TypeConverters.to_float)
     lambda_l2 = Param("lambda_l2", "L2 regularization", TypeConverters.to_float)
+    min_gain_to_split = Param(
+        "min_gain_to_split", "Min gain to accept a split", TypeConverters.to_float
+    )
     bagging_fraction = Param(
         "bagging_fraction", "Row subsample fraction", TypeConverters.to_float
     )
@@ -138,6 +141,7 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
             min_sum_hessian_in_leaf=1e-3,
             lambda_l1=0.0,
             lambda_l2=0.0,
+            min_gain_to_split=0.0,
             bagging_fraction=1.0,
             bagging_freq=0,
             bagging_seed=3,
@@ -168,6 +172,7 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
             min_sum_hessian_in_leaf=self.get(self.min_sum_hessian_in_leaf),
             lambda_l1=self.get(self.lambda_l1),
             lambda_l2=self.get(self.lambda_l2),
+            min_gain_to_split=self.get(self.min_gain_to_split),
             boosting_type=self.get(self.boosting_type),
             bagging_fraction=self.get(self.bagging_fraction),
             bagging_freq=self.get(self.bagging_freq),

@@ -191,7 +191,17 @@ def train_booster(
         shard = jax.device_put
 
     n_base = n + ((-n) % 1024)  # device-count-invariant bagging draw length
-    pad = (-n) % math.lcm(1024, nd)
+    # Row pad: the size-adaptive pallas kernel block (compute.hist_block);
+    # bagging draws stay 1024-quantized above so the extra pad never shifts
+    # them. Same rule as the kernel: big datasets pad to the large block.
+    from mmlspark_tpu.gbdt.compute import (
+        _HIST_BLK_CUTOVER,
+        _HIST_BLK_LARGE,
+        _HIST_BLK_SMALL,
+    )
+
+    blk = _HIST_BLK_LARGE if n > _HIST_BLK_CUTOVER else _HIST_BLK_SMALL
+    pad = (-n) % math.lcm(blk, nd)
     if pad:  # zero-weight pad rows, excluded from train_rows everywhere
         bins = np.concatenate([bins, np.zeros((pad, f), bins.dtype)])
         y = np.concatenate([y, np.zeros(pad, y.dtype)])
@@ -277,6 +287,14 @@ def train_booster(
     num_bins_static = int(max(binner.n_bins))
     n_bins_static = tuple(int(b) for b in binner.n_bins)  # hist grouping
     cat_static = tuple(bool(x) for x in categorical)      # reduced cat view
+
+    # Histogram implementation: the Pallas kernel (compute._hist_pallas)
+    # on a single real TPU chip — the einsum path materializes the one-hot
+    # through HBM (O(n*F*B) traffic, OOM at ~1M rows). Sharded runs keep the
+    # einsum whose replicated output XLA turns into the cross-chip psum.
+    hist_impl = (
+        "pallas" if nd == 1 and jax.default_backend() == "tpu" else "einsum"
+    )
 
     rng = np.random.default_rng(cfg.bagging_seed)
     frng = np.random.default_rng(cfg.bagging_seed + 17)
@@ -420,6 +438,7 @@ def train_booster(
             has_w=w_dev is not None,
             n_bins_static=n_bins_static,
             cat_static=cat_static,
+            hist_impl=hist_impl,
             valid_idx=(
                 jnp.asarray(vrows.astype(np.int32)) if has_valid else None
             ),
@@ -534,6 +553,7 @@ def train_booster(
                 num_bins_static, grow_cfg,
                 n_bins_static=n_bins_static,
                 cat_static=cat_static,
+                hist_impl=hist_impl,
             )
             if dart_mode:
                 tree = unpack_tree(

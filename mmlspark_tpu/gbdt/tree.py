@@ -234,6 +234,7 @@ def grow_tree_packed(
     cfg: GrowConfig,
     n_bins_static=None,  # hashable per-feature bin counts (hist grouping)
     cat_static=None,     # hashable per-feature categorical flags
+    hist_impl: str = "einsum",
 ):
     """Device-only tree growth: ONE dispatch, nothing fetched. Returns
     (packed_device, assign_device, leaf_values_device); decode the packed
@@ -262,6 +263,7 @@ def grow_tree_packed(
         max_cat_threshold=int(cfg.max_cat_threshold),
         n_bins_static=n_bins_static,
         cat_static=cat_static,
+        hist_impl=hist_impl,
     )
 
 

@@ -1,0 +1,153 @@
+"""Azure-Search-style indexing sink: push DataFrame rows as documents into
+a search index, creating the index from its JSON definition if missing.
+
+Reference: io/http/src/main/scala/services/AzureSearch.scala:143
+(AzureSearchWriter.write: parse indexJson -> SearchIndex.createIfNoneExists
+-> checkSchemaParity -> batched AddDocuments POSTs with @search.action per
+row) and AzureSearchAPI.scala (index existence check + creation calls).
+
+Endpoint-agnostic like the other cognitive clients (tests run a local mock;
+this build has no egress): `base_url` is whatever speaks the contract —
+  GET  {base_url}/indexes/{name}?api-version=...        existence probe
+  POST {base_url}/indexes?api-version=...               index creation
+  POST {base_url}/indexes/{name}/docs/index?api-version=...  uploads
+The admin key rides the `api-key` header (Azure Search's convention, unlike
+the Ocp-Apim header of the other services).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType
+from mmlspark_tpu.io.http.schema import HTTPRequestData, entity_to_string
+from mmlspark_tpu.io.http.transformer import HTTPTransformer
+
+_API_VERSION = "2017-11-11"  # the reference's pinned default
+_ACTION_COL = "@search.action"
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v
+
+
+def _send(client: HTTPTransformer, request: HTTPRequestData):
+    df = DataFrame({"request": Column(np.array([request], object), DataType.STRUCT)})
+    return client.transform(df)["response"][0]
+
+
+def _headers(key: Optional[str]) -> Dict[str, str]:
+    h = {"Content-Type": "application/json"}
+    if key:
+        h["api-key"] = key
+    return h
+
+
+def create_index_if_missing(
+    base_url: str,
+    index_json: str,
+    key: Optional[str] = None,
+    api_version: str = _API_VERSION,
+) -> bool:
+    """Probe GET /indexes/{name}; on 404 POST the definition to /indexes.
+    Returns True when the index was created, False when it already existed.
+    (SearchIndex.createIfNoneExists, AzureSearchAPI.scala.)"""
+    index = json.loads(index_json)
+    name = index.get("name")
+    if not name:
+        raise ValueError("index_json must carry a 'name' field")
+    client = HTTPTransformer(input_col="request", output_col="response")
+    probe = HTTPRequestData.get(
+        f"{base_url}/indexes/{name}?api-version={api_version}", _headers(key)
+    )
+    resp = _send(client, probe)
+    if 200 <= resp.status_line.status_code < 300:
+        return False
+    if resp.status_line.status_code != 404:
+        raise RuntimeError(
+            f"index probe failed: HTTP {resp.status_line.status_code} "
+            f"{entity_to_string(resp)!r}"
+        )
+    created = _send(
+        client,
+        HTTPRequestData.post_json(
+            f"{base_url}/indexes?api-version={api_version}", index_json,
+            _headers(key),
+        ),
+    )
+    if not 200 <= created.status_line.status_code < 300:
+        raise RuntimeError(
+            f"index creation failed: HTTP {created.status_line.status_code} "
+            f"{entity_to_string(created)!r}"
+        )
+    return True
+
+
+def write(
+    df: DataFrame,
+    base_url: str,
+    index_json: str,
+    key: Optional[str] = None,
+    action: str = "upload",
+    action_col: Optional[str] = None,
+    batch_size: int = 100,
+    api_version: str = _API_VERSION,
+) -> int:
+    """Upload every row as a search document; returns the number of batches.
+
+    - The index is created from `index_json` if missing (reference
+      AzureSearchWriter.write step 1).
+    - Schema parity: every DataFrame column must be a declared index field
+      (checkSchemaParity — a mismatched upload would 400 on the real
+      service; failing fast here keeps the contract honest).
+    - Each document carries `@search.action` — `action` for all rows, or
+      per-row values from `action_col` (reference actionCol).
+    """
+    index = json.loads(index_json)
+    declared = {f["name"] for f in index.get("fields", [])}
+    doc_cols = [c for c in df.columns if c != action_col]
+    missing = [c for c in doc_cols if c not in declared]
+    if missing:
+        raise ValueError(
+            f"columns {missing} are not fields of index "
+            f"{index.get('name')!r}; declared: {sorted(declared)}"
+        )
+
+    create_index_if_missing(base_url, index_json, key, api_version)
+
+    url = (
+        f"{base_url}/indexes/{index['name']}/docs/index"
+        f"?api-version={api_version}"
+    )
+    client = HTTPTransformer(input_col="request", output_col="response")
+    n = len(df)
+    n_batches = 0
+    for start in range(0, n, batch_size):
+        stop = min(start + batch_size, n)
+        docs = []
+        for i in range(start, stop):
+            doc = {c: _jsonable(df[c][i]) for c in doc_cols}
+            doc[_ACTION_COL] = (
+                str(df[action_col][i]) if action_col else action
+            )
+            docs.append(doc)
+        resp = _send(
+            client,
+            HTTPRequestData.post_json(
+                url, json.dumps({"value": docs}), _headers(key)
+            ),
+        )
+        if not 200 <= resp.status_line.status_code < 300:
+            raise RuntimeError(
+                f"document upload failed at batch {n_batches}: HTTP "
+                f"{resp.status_line.status_code} {entity_to_string(resp)!r}"
+            )
+        n_batches += 1
+    return n_batches

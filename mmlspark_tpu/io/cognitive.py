@@ -76,17 +76,32 @@ class CognitiveServiceBase(Transformer, Wrappable):
     def make_body(self, value: Any) -> str:
         raise NotImplementedError
 
+    def query_params(self) -> dict:
+        """URL query parameters — the reference's isURLParam ServiceParams
+        (CognitiveServiceBase.scala prepareUrl). Empty by default."""
+        return {}
+
     def _headers(self) -> dict:
         h = {"Content-Type": "application/json"}
         if self.is_set(self.subscription_key):
             h[_KEY_HEADER] = self.get(self.subscription_key)
         return h
 
+    def _full_url(self) -> str:
+        import urllib.parse
+
+        url = self.get(self.url)
+        qp = {k: v for k, v in self.query_params().items() if v is not None}
+        if not qp:
+            return url
+        sep = "&" if "?" in url else "?"
+        return url + sep + urllib.parse.urlencode(qp)
+
     def _make_request(self, value: Any) -> Optional[HTTPRequestData]:
         if value is None:
             return None
         return HTTPRequestData.post_json(
-            self.get(self.url), self.make_body(value), self._headers()
+            self._full_url(), self.make_body(value), self._headers()
         )
 
     def transform_schema(self, schema: List[Field]) -> List[Field]:
@@ -120,12 +135,15 @@ class CognitiveServiceBase(Transformer, Wrappable):
         return self._inner_cache[1].transform(df)
 
 
-class TextSentiment(CognitiveServiceBase):
-    """Text -> sentiment score, Text Analytics v2 documents contract
-    (TextAnalytics.scala TextSentiment): body {documents: [{id, language,
-    text}]}, response {documents: [{id, score}]}."""
+class TextAnalyticsBase(CognitiveServiceBase):
+    """Documents-contract base for the Text Analytics family
+    (TextAnalytics.scala:31 TextAnalyticsBase): body {documents: [{id,
+    language?, text}]}, response {documents: [...], errors: [...]}."""
 
     language = Param("language", "Language of the input text", TypeConverters.to_string)
+
+    #: subclasses without a language field in the contract set this False
+    _body_has_language = True
 
     def __init__(self, **kwargs: Any):
         super().__init__(**kwargs)
@@ -135,17 +153,185 @@ class TextSentiment(CognitiveServiceBase):
         return self.set(self.language, v)
 
     def make_body(self, value: Any) -> str:
-        return json.dumps(
-            {
-                "documents": [
-                    {
-                        "id": "1",
-                        "language": self.get_or_default(self.language),
-                        "text": str(value),
-                    }
-                ]
-            }
+        doc = {"id": "1", "text": str(value)}
+        if self._body_has_language:
+            doc["language"] = self.get_or_default(self.language)
+        return json.dumps({"documents": [doc]})
+
+
+class TextSentiment(TextAnalyticsBase):
+    """Text -> sentiment score (TextAnalytics.scala:184 TextSentiment):
+    response {documents: [{id, score}]}."""
+
+
+class LanguageDetector(TextAnalyticsBase):
+    """Text -> detected languages (TextAnalytics.scala:198 LanguageDetector):
+    the request documents carry no language field; response
+    {documents: [{id, detectedLanguages: [...]}]}."""
+
+    _body_has_language = False
+
+
+class EntityDetector(TextAnalyticsBase):
+    """Text -> linked entities (TextAnalytics.scala:212 EntityDetector):
+    response {documents: [{id, entities: [...]}]}."""
+
+
+class KeyPhraseExtractor(TextAnalyticsBase):
+    """Text -> key phrases (TextAnalytics.scala:248 KeyPhraseExtractor):
+    response {documents: [{id, keyPhrases: [...]}]}."""
+
+
+class NER(TextAnalyticsBase):
+    """Text -> named entities (TextAnalytics.scala:226 NER): response
+    {documents: [{id, entities: [...]}]}."""
+
+
+# -- Computer Vision family ----------------------------------------------------
+
+
+class _ImageServiceBase(CognitiveServiceBase):
+    """Vision services take an image by URL: body {"url": <value>}
+    (ComputerVision.scala HasImageUrl/HasImageBytes — the URL branch; this
+    build's data plane carries paths/URLs, bytes ride the same POST)."""
+
+    def make_body(self, value: Any) -> str:
+        if isinstance(value, dict):
+            return json.dumps(value)
+        return json.dumps({"url": str(value)})
+
+
+class OCR(_ImageServiceBase):
+    """Image -> printed-text regions (ComputerVision.scala:178 OCR):
+    query params language + detectOrientation, response {regions: [...]}."""
+
+    language = Param("language", "Language of the text in the image",
+                     TypeConverters.to_string)
+    detect_orientation = Param(
+        "detect_orientation", "Detect image orientation before OCR",
+        TypeConverters.to_boolean,
+    )
+
+    def __init__(self, **kwargs: Any):
+        super().__init__(**kwargs)
+        self._set_defaults(language="unk", detect_orientation=True)
+
+    def query_params(self) -> dict:
+        return {
+            "language": self.get_or_default(self.language),
+            "detectOrientation": str(
+                self.get_or_default(self.detect_orientation)
+            ).lower(),
+        }
+
+
+class AnalyzeImage(_ImageServiceBase):
+    """Image -> visual-feature analysis (ComputerVision.scala:302
+    AnalyzeImage): query params visualFeatures/details/language, response
+    {categories, tags, description, ...}."""
+
+    visual_features = Param(
+        "visual_features", "Visual feature types to return (comma-joined)",
+        TypeConverters.to_list_string,
+    )
+    details = Param("details", "Domain-specific details to return",
+                    TypeConverters.to_list_string)
+    language = Param("language", "Language of the response",
+                     TypeConverters.to_string)
+
+    def __init__(self, **kwargs: Any):
+        super().__init__(**kwargs)
+        self._set_defaults(
+            visual_features=["Categories"], details=[], language="en"
         )
+
+    def query_params(self) -> dict:
+        feats = self.get_or_default(self.visual_features)
+        details = self.get_or_default(self.details)
+        return {
+            "visualFeatures": ",".join(feats) if feats else None,
+            "details": ",".join(details) if details else None,
+            "language": self.get_or_default(self.language),
+        }
+
+
+class GenerateThumbnails(_ImageServiceBase):
+    """Image -> thumbnail bytes (ComputerVision.scala:282
+    GenerateThumbnails): query params width/height/smartCropping."""
+
+    width = Param("width", "Thumbnail width in pixels", TypeConverters.to_int)
+    height = Param("height", "Thumbnail height in pixels", TypeConverters.to_int)
+    smart_cropping = Param("smart_cropping", "Intelligently crop the image",
+                           TypeConverters.to_boolean)
+
+    def __init__(self, **kwargs: Any):
+        super().__init__(**kwargs)
+        self._set_defaults(width=64, height=64, smart_cropping=True)
+
+    def query_params(self) -> dict:
+        return {
+            "width": self.get_or_default(self.width),
+            "height": self.get_or_default(self.height),
+            "smartCropping": str(
+                self.get_or_default(self.smart_cropping)
+            ).lower(),
+        }
+
+
+# -- Face family ---------------------------------------------------------------
+
+
+class DetectFace(_ImageServiceBase):
+    """Image -> detected faces (Face.scala:19 DetectFace): query params
+    returnFaceId / returnFaceLandmarks / returnFaceAttributes, response a
+    list of {faceId, faceRectangle, faceAttributes?}."""
+
+    return_face_id = Param("return_face_id", "Return faceIds of detected faces",
+                           TypeConverters.to_boolean)
+    return_face_landmarks = Param(
+        "return_face_landmarks", "Return face landmarks", TypeConverters.to_boolean
+    )
+    return_face_attributes = Param(
+        "return_face_attributes",
+        "Face attributes to return (age, gender, ... comma-joined)",
+        TypeConverters.to_list_string,
+    )
+
+    def __init__(self, **kwargs: Any):
+        super().__init__(**kwargs)
+        self._set_defaults(
+            return_face_id=True, return_face_landmarks=False,
+            return_face_attributes=[],
+        )
+
+    def query_params(self) -> dict:
+        attrs = self.get_or_default(self.return_face_attributes)
+        return {
+            "returnFaceId": str(self.get_or_default(self.return_face_id)).lower(),
+            "returnFaceLandmarks": str(
+                self.get_or_default(self.return_face_landmarks)
+            ).lower(),
+            "returnFaceAttributes": ",".join(attrs) if attrs else None,
+        }
+
+
+class VerifyFaces(CognitiveServiceBase):
+    """Two face ids -> same-person verdict (Face.scala VerifyFaces): the
+    input column holds a (faceId1, faceId2) pair (list/tuple/dict); body
+    {faceId1, faceId2}, response {isIdentical, confidence}."""
+
+    def make_body(self, value: Any) -> str:
+        if isinstance(value, dict):
+            return json.dumps(
+                {"faceId1": value["faceId1"], "faceId2": value["faceId2"]}
+            )
+        pair = list(value)
+        if len(pair) != 2:
+            raise ValueError(
+                f"VerifyFaces input must be a (faceId1, faceId2) pair, got "
+                f"{value!r}"
+            )
+        return json.dumps({"faceId1": str(pair[0]), "faceId2": str(pair[1])})
 
 
 class AnomalyDetector(CognitiveServiceBase):

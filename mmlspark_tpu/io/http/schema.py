@@ -147,6 +147,12 @@ class HTTPRequestData:
         )
 
     @staticmethod
+    def get(url: str, headers: Optional[Dict[str, str]] = None) -> "HTTPRequestData":
+        """Body-less GET (index/existence probes, e.g. azure_search)."""
+        hs = [HeaderData(k, v) for k, v in (headers or {}).items()]
+        return HTTPRequestData(RequestLineData("GET", url), hs, None)
+
+    @staticmethod
     def post_json(url: str, body: str, headers: Optional[Dict[str, str]] = None,
                   method: str = "POST") -> "HTTPRequestData":
         """The JSONInputParser product: method+url+JSON entity

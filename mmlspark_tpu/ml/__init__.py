@@ -13,10 +13,20 @@ from mmlspark_tpu.ml.classical import (
     LogisticRegression,
     LogisticRegressionModel,
 )
+from mmlspark_tpu.ml.forest import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
 
 __all__ = [
+    "DecisionTreeClassifier",
+    "DecisionTreeRegressor",
     "LinearRegression",
     "LinearRegressionModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "RandomForestClassifier",
+    "RandomForestRegressor",
 ]

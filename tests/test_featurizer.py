@@ -339,3 +339,42 @@ class TestBuilderZoo:
 
         with pytest.raises(ValueError, match="factory must be"):
             _materialize_builder({"factory": "os:system"}, str(tmp_path / "x"))
+
+
+class TestImageLIMEBatching:
+    def test_multi_image_batch_matches_per_image(self):
+        """Cross-image batching (one model call for many images' sample
+        sets) must produce IDENTICAL weights to explaining each image in
+        its own transform call (round-5 verdict item 6)."""
+        imgs, _ = _patch_xor_images(4, seed=9)
+        model = _PatchBrightness()
+
+        def make_lime():
+            lime = ImageLIME(model=model, input_col="image",
+                             output_col="weights", label_col="prediction")
+            lime.set_n_samples(60).set_cell_size(8.0).set_sampling_fraction(0.5)
+            return lime
+
+        batched = make_lime().transform(_image_df(imgs))["weights"]
+        for i in range(len(imgs)):
+            solo = make_lime().transform(_image_df(imgs[i][None]))["weights"][0]
+            np.testing.assert_allclose(batched[i], solo, rtol=1e-10)
+
+    def test_mixed_shapes_grouped(self):
+        """Images of different shapes can't share a batch; they still all
+        get explained."""
+        rng = np.random.default_rng(2)
+        small = rng.integers(0, 255, (16, 16, 3)).astype(np.uint8)
+        big = rng.integers(0, 255, (24, 24, 3)).astype(np.uint8)
+        rows = np.empty(3, object)
+        from mmlspark_tpu.core.schema import make_image_row
+        rows[0] = make_image_row(small, "a")
+        rows[1] = make_image_row(big, "b")
+        rows[2] = make_image_row(small, "c")
+        df = DataFrame({"image": Column(rows, DataType.STRUCT)})
+        lime = ImageLIME(model=_PatchBrightness(), input_col="image",
+                         output_col="weights", label_col="prediction")
+        lime.set_n_samples(30).set_cell_size(8.0)
+        out = lime.transform(df)
+        for w in out["weights"]:
+            assert w is not None and np.isfinite(np.asarray(w)).all()

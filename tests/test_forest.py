@@ -1,0 +1,92 @@
+"""RandomForest / DecisionTree learners (reference parity:
+DefaultHyperparams.scala:17-95, benchmarks_VerifyTrainClassifier.csv:6)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.ml import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
+
+
+def _df(n=500, seed=4):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, n).astype(np.float64)
+    x = rng.normal(size=(n, 8))
+    x[:, 0] += 1.5 * y
+    x[:, 1] += y * x[:, 2]  # interaction a depth-1 stump can't catch
+    return DataFrame.from_dict({"features": x, "label": y}), y
+
+
+def test_decision_tree_is_single_tree():
+    df, y = _df()
+    m = DecisionTreeClassifier(max_depth=4).fit(df)
+    booster = m.get_booster()
+    assert len(booster.trees) == 1
+    acc = (m.transform(df)["prediction"] == y).mean()
+    assert acc > 0.75
+
+
+def test_random_forest_has_num_trees_and_beats_stump():
+    df, y = _df()
+    rf = RandomForestClassifier(num_trees=25, max_depth=5, bagging_seed=0)
+    m = rf.fit(df)
+    assert len(m.get_booster().trees) == 25
+    acc_rf = (m.transform(df)["prediction"] == y).mean()
+    stump = DecisionTreeClassifier(max_depth=1).fit(df)
+    acc_stump = (stump.transform(df)["prediction"] == y).mean()
+    assert acc_rf > acc_stump
+
+
+def test_feature_subset_strategy():
+    df, _ = _df()
+    rf = RandomForestClassifier()
+    assert rf._feature_fraction(9) == pytest.approx(3 / 9)
+    rf.set(rf.feature_subset_strategy, "onethird")
+    assert rf._feature_fraction(9) == pytest.approx(1 / 3)
+    rf.set(rf.feature_subset_strategy, "0.5")
+    assert rf._feature_fraction(9) == 0.5
+    rf.set(rf.feature_subset_strategy, "bogus")
+    with pytest.raises(ValueError, match="feature_subset_strategy"):
+        rf._feature_fraction(9)
+
+
+def test_regressors_fit_predict():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(400, 6))
+    y = 2 * x[:, 0] + np.sin(x[:, 1]) + 0.05 * rng.normal(size=400)
+    df = DataFrame.from_dict({"features": x, "label": y})
+    for cls in (RandomForestRegressor, DecisionTreeRegressor):
+        m = cls(max_depth=5).fit(df)
+        pred = m.transform(df)["prediction"]
+        rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+        assert rmse < 1.0, (cls.__name__, rmse)
+
+
+def test_default_hyperparams_for_forest():
+    from mmlspark_tpu.automl.hyperparam import DefaultHyperparams
+
+    rf = RandomForestClassifier()
+    entries = DefaultHyperparams.for_estimator(rf)
+    names = {name for _, name, _ in entries}
+    assert {"max_bins", "max_depth", "num_trees", "subsampling_rate"} <= names
+    dt = DecisionTreeClassifier()
+    names = {n for _, n, _ in DefaultHyperparams.for_estimator(dt)}
+    assert "min_instances_per_node" in names and "num_trees" not in names
+
+
+def test_save_load_roundtrip(tmp_path):
+    from mmlspark_tpu.core.serialize import load_stage
+
+    df, y = _df()
+    rf = RandomForestClassifier(num_trees=5, max_depth=3)
+    m = rf.fit(df)
+    m.save(str(tmp_path / "rf"))
+    m2 = load_stage(str(tmp_path / "rf"))
+    np.testing.assert_allclose(
+        m.transform(df)["probability"], m2.transform(df)["probability"]
+    )

@@ -456,6 +456,34 @@ def _lgbr():
     return LightGBMRegressor(num_iterations=3, num_leaves=4), default_df()
 
 
+@fuzzer("mmlspark_tpu.ml.forest.RandomForestClassifier")
+def _rfc():
+    from mmlspark_tpu.ml import RandomForestClassifier
+
+    return RandomForestClassifier(num_trees=3, max_depth=3), default_df()
+
+
+@fuzzer("mmlspark_tpu.ml.forest.RandomForestRegressor")
+def _rfr():
+    from mmlspark_tpu.ml import RandomForestRegressor
+
+    return RandomForestRegressor(num_trees=3, max_depth=3), default_df()
+
+
+@fuzzer("mmlspark_tpu.ml.forest.DecisionTreeClassifier")
+def _dtc():
+    from mmlspark_tpu.ml import DecisionTreeClassifier
+
+    return DecisionTreeClassifier(max_depth=3), default_df()
+
+
+@fuzzer("mmlspark_tpu.ml.forest.DecisionTreeRegressor")
+def _dtr():
+    from mmlspark_tpu.ml import DecisionTreeRegressor
+
+    return DecisionTreeRegressor(max_depth=3), default_df()
+
+
 @fuzzer("mmlspark_tpu.ml.classical.LogisticRegression")
 def _logreg():
     from mmlspark_tpu.ml.classical import LogisticRegression
@@ -688,6 +716,27 @@ EXEMPT = {
     "mmlspark_tpu.io.cognitive.TextSentiment":
         "needs a live HTTP endpoint; covered by tests/test_longtail.py",
     "mmlspark_tpu.io.cognitive.AnomalyDetector":
+        "needs a live HTTP endpoint; covered by tests/test_longtail.py",
+    "mmlspark_tpu.io.cognitive.TextAnalyticsBase":
+        "abstract documents-contract base; concrete clients covered by "
+        "tests/test_longtail.py",
+    "mmlspark_tpu.io.cognitive.LanguageDetector":
+        "needs a live HTTP endpoint; covered by tests/test_longtail.py",
+    "mmlspark_tpu.io.cognitive.EntityDetector":
+        "needs a live HTTP endpoint; covered by tests/test_longtail.py",
+    "mmlspark_tpu.io.cognitive.KeyPhraseExtractor":
+        "needs a live HTTP endpoint; covered by tests/test_longtail.py",
+    "mmlspark_tpu.io.cognitive.NER":
+        "needs a live HTTP endpoint; covered by tests/test_longtail.py",
+    "mmlspark_tpu.io.cognitive.OCR":
+        "needs a live HTTP endpoint; covered by tests/test_longtail.py",
+    "mmlspark_tpu.io.cognitive.AnalyzeImage":
+        "needs a live HTTP endpoint; covered by tests/test_longtail.py",
+    "mmlspark_tpu.io.cognitive.GenerateThumbnails":
+        "needs a live HTTP endpoint; covered by tests/test_longtail.py",
+    "mmlspark_tpu.io.cognitive.DetectFace":
+        "needs a live HTTP endpoint; covered by tests/test_longtail.py",
+    "mmlspark_tpu.io.cognitive.VerifyFaces":
         "needs a live HTTP endpoint; covered by tests/test_longtail.py",
 }
 
