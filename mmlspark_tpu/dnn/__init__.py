@@ -12,7 +12,9 @@ a configurable compute dtype (bfloat16 on TPU), static shapes throughout.
 from mmlspark_tpu.dnn.network import LAYER_KINDS, Network, layer
 from mmlspark_tpu.dnn.resnet import (
     mlp,
+    resnet18,
     resnet20_cifar,
+    resnet34,
     resnet50,
     resnet_imagenet,
     resnet_mini,
@@ -23,7 +25,9 @@ __all__ = [
     "Network",
     "layer",
     "mlp",
+    "resnet18",
     "resnet20_cifar",
+    "resnet34",
     "resnet50",
     "resnet_imagenet",
     "resnet_mini",

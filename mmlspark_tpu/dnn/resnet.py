@@ -111,16 +111,22 @@ def resnet_imagenet(
     input_shape: Sequence[int] = (224, 224, 3),
     compute_dtype: str = "float32",
 ) -> Network:
-    """ImageNet-style bottleneck ResNet (50/101/152): 7x7/2 stem + 3x3/2
-    maxpool, 4 stages of bottleneck blocks at 64/128/256/512 base filters
-    (x4 expansion), global average pool, dense head.
+    """ImageNet-style ResNet: 7x7/2 stem + SAME 3x3/2 maxpool, 4 stages at
+    64/128/256/512 base filters, global average pool, dense head. Depths
+    18/34 use basic blocks; 50/101/152 use bottleneck blocks (x4 expansion).
 
-    The flagship transfer-learning network — the role CNTK ResNet-50 plays
-    for the reference (ModelDownloader.scala:209-267 downloadByName
+    The flagship transfer-learning network family — the role the CNTK zoo
+    plays for the reference (ModelDownloader.scala:209-267 downloadByName
     "ResNet50"; consumed by ImageFeaturizer.scala:129-177)."""
-    stages = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
-    if depth not in stages:
-        raise ValueError(f"ImageNet ResNet depth must be one of {sorted(stages)}")
+    basic = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3)}
+    bottleneck = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+    if depth not in basic and depth not in bottleneck:
+        raise ValueError(
+            f"ImageNet ResNet depth must be one of "
+            f"{sorted(basic) + sorted(bottleneck)}"
+        )
+    use_bottleneck = depth in bottleneck
+    stages = bottleneck.get(depth) or basic[depth]
     spec: List[dict] = [
         {"kind": "conv", "name": "stem", "filters": 64, "kernel": 7, "stride": 2,
          "use_bias": False},
@@ -130,11 +136,16 @@ def resnet_imagenet(
          "padding": "SAME"},
     ]
     for stage, (filters, n_blocks) in enumerate(
-        zip((64, 128, 256, 512), stages[depth])
+        zip((64, 128, 256, 512), stages)
     ):
         for block in range(n_blocks):
             stride = 2 if (stage > 0 and block == 0) else 1
-            cfg = _bottleneck_block(filters, stride, project=block == 0)
+            if use_bottleneck:
+                cfg = _bottleneck_block(filters, stride, project=block == 0)
+            else:
+                # basic blocks: projection only where shape changes
+                project = block == 0 and stage > 0
+                cfg = _basic_block(filters, stride, project)
             cfg["name"] = f"stage{stage + 1}_block{block + 1}"
             spec.append(cfg)
             spec.append(
@@ -145,6 +156,18 @@ def resnet_imagenet(
         {"kind": "dense", "name": "logits", "units": num_classes},
     ]
     return Network(spec, input_shape, compute_dtype)
+
+
+def resnet18(num_classes: int = 1000,
+             input_shape: Sequence[int] = (224, 224, 3),
+             compute_dtype: str = "float32") -> Network:
+    return resnet_imagenet(18, num_classes, input_shape, compute_dtype)
+
+
+def resnet34(num_classes: int = 1000,
+             input_shape: Sequence[int] = (224, 224, 3),
+             compute_dtype: str = "float32") -> Network:
+    return resnet_imagenet(34, num_classes, input_shape, compute_dtype)
 
 
 def resnet50(

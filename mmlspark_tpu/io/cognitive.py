@@ -87,11 +87,12 @@ class CognitiveServiceBase(Transformer, Wrappable):
             h[_KEY_HEADER] = self.get(self.subscription_key)
         return h
 
-    def _full_url(self) -> str:
+    def _full_url(self, extra: Optional[dict] = None) -> str:
         import urllib.parse
 
         url = self.get(self.url)
         qp = {k: v for k, v in self.query_params().items() if v is not None}
+        qp.update(extra or {})
         if not qp:
             return url
         sep = "&" if "?" in url else "?"
@@ -313,6 +314,52 @@ class DetectFace(_ImageServiceBase):
             ).lower(),
             "returnFaceAttributes": ",".join(attrs) if attrs else None,
         }
+
+
+class BingImageSearch(CognitiveServiceBase):
+    """Search query -> image results (ImageSearch.scala:63 BingImageSearch):
+    GET with q/count/offset/mkt/imageType query params, response
+    {value: [{contentUrl, ...}]}. The input column holds the query string."""
+
+    count = Param("count", "Number of images to return", TypeConverters.to_int)
+    offset = Param("offset", "Zero-based result offset", TypeConverters.to_int)
+    market = Param("market", "Result market, e.g. en-US", TypeConverters.to_string)
+    image_type = Param("image_type", "Filter by image type (Photo, ...)",
+                       TypeConverters.to_string)
+
+    def __init__(self, **kwargs: Any):
+        super().__init__(**kwargs)
+        self._set_defaults(count=10, offset=0, market="en-US",
+                           image_type=None)
+
+    def query_params(self) -> dict:
+        return {
+            "count": self.get_or_default(self.count),
+            "offset": self.get_or_default(self.offset),
+            "mkt": self.get_or_default(self.market),
+            "imageType": self.get_or_default(self.image_type),
+        }
+
+    def make_body(self, value: Any) -> str:  # unused for GET
+        return ""
+
+    def _make_request(self, value: Any) -> Optional[HTTPRequestData]:
+        if value is None:
+            return None
+        return HTTPRequestData.get(
+            self._full_url(extra={"q": str(value)}), self._headers()
+        )
+
+    @staticmethod
+    def content_urls(response: Any) -> List[str]:
+        """Extract contentUrl list from a search response (the reference's
+        downloadFromUrls companion pipeline starts here)."""
+        if not isinstance(response, dict):
+            return []
+        return [
+            v["contentUrl"] for v in response.get("value", [])
+            if isinstance(v, dict) and "contentUrl" in v
+        ]
 
 
 class VerifyFaces(CognitiveServiceBase):

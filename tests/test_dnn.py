@@ -209,3 +209,18 @@ def test_same_padded_avg_pool_edge_counts():
     v = net.init(jax.random.PRNGKey(0))
     y = np.asarray(net.apply(v, np.ones((1, 7, 7, 1), np.float32)))
     np.testing.assert_allclose(y, 1.0, rtol=1e-6)
+
+
+def test_resnet18_34_param_counts():
+    """Basic-block ImageNet variants match the canonical parameter counts
+    (11.69M / 21.80M) — the zoo can grow past CIFAR shapes."""
+    from mmlspark_tpu.dnn import resnet18, resnet34
+
+    for fn, expect in ((resnet18, 11_689_512), (resnet34, 21_797_672)):
+        net = fn()
+        v = jax.eval_shape(net.init, jax.random.PRNGKey(0))
+        n = sum(
+            int(np.prod(a.shape))
+            for a in jax.tree_util.tree_leaves(v["params"])
+        )
+        assert n == expect, (fn.__name__, n)

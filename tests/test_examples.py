@@ -28,3 +28,65 @@ def test_example_runs(path):
         f"{os.path.basename(path)} failed:\n{res.stdout[-2000:]}\n{res.stderr[-2000:]}"
     )
     assert "OK" in res.stdout
+
+
+def test_serve_entrypoint_round_trip(tmp_path):
+    """The container serving entrypoint (tools/docker/serve_entrypoint.py)
+    loads a saved stage and answers HTTP — the deploy story's smoke test
+    (docs/deployment.md)."""
+    import http.client
+    import json
+    import signal
+    import time
+
+    import numpy as np
+
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(500, 5))
+    y = (x[:, 0] > 0).astype(np.float64)
+    m = LightGBMClassifier(num_iterations=5, num_leaves=7, verbosity=0).fit(
+        DataFrame.from_dict({"features": x, "label": y})
+    )
+    mp = str(tmp_path / "model")
+    m.save(mp)
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(REPO, "tools", "docker", "serve_entrypoint.py"),
+         "--model", mp, "--host", "127.0.0.1", "--port", "0",
+         "--api", "score", "--input-schema", '{"features": "vector"}',
+         "--reply-col", "prediction"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        line, seen = "", []
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if proc.poll() is not None:  # crashed at startup
+                seen.append(proc.stdout.read())
+                break
+            line = proc.stdout.readline()
+            seen.append(line)
+            if "serving" in line:
+                break
+        assert "serving" in line, (
+            f"entrypoint never came up; output:\n{''.join(seen)[-2000:]}"
+        )
+        port = int(line.rsplit(":", 1)[1].split("/")[0])
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        body = json.dumps({"features": x[0].tolist()}).encode()
+        conn.request("POST", "/score", body,
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 200
+        assert json.loads(r.read()) in (0.0, 1.0)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()

@@ -738,6 +738,8 @@ EXEMPT = {
         "needs a live HTTP endpoint; covered by tests/test_longtail.py",
     "mmlspark_tpu.io.cognitive.VerifyFaces":
         "needs a live HTTP endpoint; covered by tests/test_longtail.py",
+    "mmlspark_tpu.io.cognitive.BingImageSearch":
+        "needs a live HTTP endpoint; covered by tests/test_longtail.py",
 }
 
 # Model classes whose estimator runs in the sweep: the fit() in the sweep IS

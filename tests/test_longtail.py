@@ -371,3 +371,51 @@ class TestAzureSearch:
             assert "act" not in batch["value"][0]
         finally:
             httpd.shutdown()
+
+
+class TestImageSearch:
+    def test_bing_image_search_get_contract(self):
+        """BingImageSearch issues a GET with q/count/mkt query params and
+        parses the {value: [...]} response (ImageSearch.scala:63)."""
+        import http.server
+
+        from mmlspark_tpu.io.cognitive import BingImageSearch
+
+        captured = []
+        body = json.dumps(
+            {"value": [{"contentUrl": "http://img/1.png"},
+                       {"contentUrl": "http://img/2.png"}]}
+        ).encode()
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                captured.append((self.path, dict(self.headers)))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}/images/search"
+            df = DataFrame.from_dict(
+                {"q": np.array(["red car"], object)},
+                types={"q": DataType.STRING},
+            )
+            stage = BingImageSearch(
+                url=url, subscription_key="k", input_col="q",
+                output_col="results", count=2,
+            )
+            out = stage.transform(df)
+            path, headers = captured[0]
+            assert "q=red+car" in path and "count=2" in path and "mkt=en-US" in path
+            assert headers.get("Ocp-Apim-Subscription-Key") == "k"
+            urls = BingImageSearch.content_urls(out["results"][0])
+            assert urls == ["http://img/1.png", "http://img/2.png"]
+        finally:
+            httpd.shutdown()

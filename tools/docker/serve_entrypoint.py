@@ -1,0 +1,80 @@
+"""Container serving entrypoint: load a saved stage and serve it.
+
+Usage (inside the image, or anywhere the package is installed):
+    python serve_entrypoint.py --model /models/my_model \
+        --host 0.0.0.0 --port 8000 --api score \
+        --input-schema '{"features": "vector"}' --reply-col prediction
+
+The model directory is anything `mmlspark_tpu.core.serialize.load_stage`
+reads back — a fitted pipeline, a LightGBM model, a TPUModel, ... The HTTP
+contract is the serving tier's (docs/serving.md): POST JSON to /<api>,
+reply is the reply column serialized back.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True, help="saved stage directory")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--api", default="score")
+    ap.add_argument("--reply-col", default="prediction")
+    ap.add_argument("--mode", default="micro_batch",
+                    choices=["continuous", "micro_batch"])
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument(
+        "--input-schema", default=None,
+        help='JSON {"col": "double"|"vector"|"string"} request schema',
+    )
+    args = ap.parse_args(argv)
+
+    from mmlspark_tpu.core.dataframe import DataType
+    from mmlspark_tpu.core.serialize import load_stage
+    from mmlspark_tpu.serving import DistributedServingServer, serve_pipeline
+
+    schema = None
+    if args.input_schema:
+        schema = {
+            k: DataType(v) for k, v in json.loads(args.input_schema).items()
+        }
+
+    if args.workers > 1:
+        from mmlspark_tpu.serving import make_reply, parse_request
+
+        def handler_factory():
+            # one model replica PER WORKER: stages may hold per-instance
+            # state (caches, clients) and workers only serialize through
+            # their own model lock (serving/distributed.py contract)
+            replica = load_stage(args.model)
+
+            def handler(df):
+                parsed = parse_request(df, schema)
+                return make_reply(replica.transform(parsed), args.reply_col)
+            return handler
+
+        server = DistributedServingServer(
+            handler_factory, n_workers=args.workers, host=args.host,
+            port=args.port, api_name=args.api, mode=args.mode,
+        ).start()
+    else:
+        server = serve_pipeline(
+            load_stage(args.model), input_schema=schema, host=args.host,
+            port=args.port, api_name=args.api, reply_col=args.reply_col,
+            mode=args.mode,
+        ).start()
+
+    print(f"serving {args.model} at {server.url}", flush=True)
+    signal.sigwait({signal.SIGINT, signal.SIGTERM})
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
