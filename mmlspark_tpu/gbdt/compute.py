@@ -712,10 +712,12 @@ def _grow_tree_body(
         "n_bins_static", "cat_static", "hist_impl",
     ),
 )
-def grow_tree_fused(*args, **kwargs):
+def grow_tree_fused(bins, *args, **kwargs):
     """Single-dispatch wrapper over _grow_tree_body (legacy per-iteration
     path: dart/goss/early-stopping, and standalone tree growth)."""
-    return _grow_tree_body(*args, **kwargs)
+    import jax.numpy as jnp
+
+    return _grow_tree_body(bins.astype(jnp.int32), *args, **kwargs)
 
 
 @functools.partial(
@@ -727,7 +729,7 @@ def grow_tree_fused(*args, **kwargs):
     ),
 )
 def boost_loop_fused(
-    bins,            # (n, F) int32
+    bins,            # (n, F) uint8 wire format or int32; cast on device
     y,               # (n,) f32
     w,               # (n,) f32 (ignored when has_w=False)
     raw0,            # (n,) f32 or (n, k) f32
@@ -776,6 +778,7 @@ def boost_loop_fused(
     """
     import jax.numpy as jnp
 
+    bins = bins.astype(jnp.int32)  # uint8 wire format -> device int32 once
     w_ = w if has_w else None
     if rf:
         g0, h0 = objective.grad_hess(raw0, y, w_)

@@ -63,19 +63,25 @@ def test_serve_entrypoint_round_trip(tmp_path):
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     try:
-        line, seen = "", []
-        deadline = time.time() + 60
-        while time.time() < deadline:
-            if proc.poll() is not None:  # crashed at startup
-                seen.append(proc.stdout.read())
-                break
-            line = proc.stdout.readline()
-            seen.append(line)
-            if "serving" in line:
-                break
-        assert "serving" in line, (
+        import threading
+
+        seen: list = []
+        came_up = threading.Event()
+
+        def pump():
+            for ln in proc.stdout:
+                seen.append(ln)
+                if "serving" in ln:
+                    came_up.set()
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        # the reader thread enforces the deadline even if the entrypoint
+        # hangs without printing (readline itself has no timeout)
+        assert came_up.wait(timeout=60), (
             f"entrypoint never came up; output:\n{''.join(seen)[-2000:]}"
         )
+        line = next(ln for ln in seen if "serving" in ln)
         port = int(line.rsplit(":", 1)[1].split("/")[0])
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
         body = json.dumps({"features": x[0].tolist()}).encode()

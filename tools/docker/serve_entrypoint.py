@@ -71,6 +71,9 @@ def main(argv=None) -> int:
         ).start()
 
     print(f"serving {args.model} at {server.url}", flush=True)
+    # block the signals so sigwait receives them (otherwise SIGTERM's
+    # default disposition kills the process before stop() can drain)
+    signal.pthread_sigmask(signal.SIG_BLOCK, {signal.SIGINT, signal.SIGTERM})
     signal.sigwait({signal.SIGINT, signal.SIGTERM})
     server.stop()
     return 0
