@@ -128,6 +128,9 @@ class DefaultHyperparams:
             builder.add_hyperparam(estimator, "max_depth", IntRangeHyperParam(2, 5))
             builder.add_hyperparam(estimator, "min_info_gain", DoubleRangeHyperParam(0.0, 0.5))
             builder.add_hyperparam(estimator, "min_instances_per_node", IntRangeHyperParam(1, 8))
+        elif name == "NaiveBayes":
+            # DefaultHyperparams.scala:88-92 (NaiveBayes smoothing range)
+            builder.add_hyperparam(estimator, "smoothing", DoubleRangeHyperParam(0.0, 1.0))
         else:
             raise ValueError(f"no default hyperparams for {name}")
         return builder.build()

@@ -13,6 +13,7 @@ from mmlspark_tpu.ml.classical import (
     LogisticRegression,
     LogisticRegressionModel,
 )
+from mmlspark_tpu.ml.bayes import NaiveBayes, NaiveBayesModel
 from mmlspark_tpu.ml.forest import (
     DecisionTreeClassifier,
     DecisionTreeRegressor,
@@ -27,6 +28,8 @@ __all__ = [
     "LinearRegressionModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "NaiveBayes",
+    "NaiveBayesModel",
     "RandomForestClassifier",
     "RandomForestRegressor",
 ]

@@ -1,0 +1,182 @@
+"""NaiveBayes — closed-form Bayes classifiers on device.
+
+Reference parity: TrainClassifier / TuneHyperparameters wrap SparkML's
+NaiveBayes with a smoothing search range
+(tune-hyperparameters/src/main/scala/DefaultHyperparams.scala:88-92).
+
+TPU-first: both fits are single-pass matmuls — class-conditional sums are
+one `onehot(y).T @ x` contraction, so the whole fit is MXU work with no
+per-class Python loops.
+- multinomial: count features (hashed TF vectors from Featurize/
+  TextFeaturizer); log P(x|c) ~ x . log theta_c with Laplace smoothing.
+- gaussian: per-class feature mean/variance; diagonal-covariance
+  log-likelihood.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType, Field
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasFeaturesCol,
+    HasLabelCol,
+    Param,
+    TypeConverters,
+    Wrappable,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.models.tpu_model import extract_feature_matrix
+
+
+class NaiveBayes(Estimator, HasFeaturesCol, HasLabelCol, Wrappable):
+    """Multinomial (default) or Gaussian naive Bayes classifier."""
+
+    smoothing = Param("smoothing", "Additive (Laplace) smoothing",
+                      TypeConverters.to_float)
+    model_type = Param("model_type", "multinomial | gaussian",
+                       TypeConverters.to_string)
+    prediction_col = Param("prediction_col", "Prediction column",
+                           TypeConverters.to_string)
+    probability_col = Param("probability_col", "Probability column",
+                            TypeConverters.to_string)
+
+    def __init__(self, **kwargs: Any):
+        super().__init__()
+        self._set_defaults(
+            features_col="features", label_col="label",
+            prediction_col="prediction", probability_col="probability",
+            smoothing=1.0, model_type="multinomial",
+        )
+        self.set_params(**kwargs)
+
+    def fit(self, df: DataFrame) -> "NaiveBayesModel":
+        import jax.numpy as jnp
+
+        kind = self.get(self.model_type)
+        if kind not in ("multinomial", "gaussian"):
+            raise ValueError(f"model_type {kind!r}: multinomial | gaussian")
+        fcol = df.column(self.get(self.features_col))
+        d = fcol.values.shape[1] if fcol.values.ndim == 2 else 1
+        x = np.asarray(
+            extract_feature_matrix(fcol, (d,), self.get(self.features_col)),
+            np.float32,
+        )
+        y = np.asarray(
+            [float(v) for v in df[self.get(self.label_col)]], np.float32
+        )
+        k = int(np.nanmax(y)) + 1 if len(y) else 2
+        k = max(2, k)
+        if kind == "multinomial" and (x < 0).any():
+            raise ValueError(
+                "multinomial NaiveBayes needs non-negative features "
+                "(counts); use model_type='gaussian'"
+            )
+
+        onehot = jnp.asarray(
+            np.eye(k, dtype=np.float32)[y.astype(np.int64)]
+        )                                              # (n, k)
+        xj = jnp.asarray(x)
+        counts = onehot.sum(axis=0)                    # (k,)
+        sums = onehot.T @ xj                           # (k, d) — one matmul
+        alpha = self.get(self.smoothing)
+        log_prior = np.log(
+            (np.asarray(counts) + alpha)
+            / (len(y) + alpha * k)
+        )
+        if kind == "multinomial":
+            tot = np.asarray(sums).sum(axis=1, keepdims=True)
+            # clamp: alpha=0 with a zero count gives log(0) = -inf, and the
+            # dense scoring matmul turns 0 * -inf into NaN probabilities
+            a = max(alpha, 1e-10)
+            log_theta = np.log(
+                (np.asarray(sums) + a) / (tot + a * x.shape[1])
+            )
+            model = NaiveBayesModel(
+                kind="multinomial", log_prior=log_prior, log_theta=log_theta
+            )
+        else:
+            sq_sums = np.asarray(onehot.T @ (xj * xj))  # (k, d)
+            cnt = np.maximum(np.asarray(counts), 1.0)[:, None]
+            mean = np.asarray(sums) / cnt
+            var = np.maximum(sq_sums / cnt - mean ** 2, 1e-9) + alpha * 1e-9
+            model = NaiveBayesModel(
+                kind="gaussian", log_prior=log_prior, mean=mean, var=var
+            )
+        for p in ("features_col", "prediction_col", "probability_col"):
+            model.set(p, self.get(p))
+        return model
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [
+            Field(self.get(self.probability_col), DataType.VECTOR),
+            Field(self.get(self.prediction_col), DataType.DOUBLE),
+        ]
+
+
+class NaiveBayesModel(Model, HasFeaturesCol, Wrappable):
+    """Fitted NaiveBayes: log-likelihood scoring + argmax prediction."""
+
+    kind = Param("kind", "multinomial | gaussian", TypeConverters.to_string)
+    log_prior = ComplexParam("log_prior", "(k,) class log priors")
+    log_theta = ComplexParam("log_theta", "(k, d) multinomial log params")
+    mean = ComplexParam("mean", "(k, d) gaussian means")
+    var = ComplexParam("var", "(k, d) gaussian variances")
+    prediction_col = Param("prediction_col", "Prediction column",
+                           TypeConverters.to_string)
+    probability_col = Param("probability_col", "Probability column",
+                            TypeConverters.to_string)
+
+    def __init__(self, kind: Optional[str] = None, log_prior=None,
+                 log_theta=None, mean=None, var=None):
+        super().__init__()
+        self._set_defaults(
+            features_col="features", prediction_col="prediction",
+            probability_col="probability",
+        )
+        if kind is not None:
+            self.set(self.kind, kind)
+        for name, v in (("log_prior", log_prior), ("log_theta", log_theta),
+                        ("mean", mean), ("var", var)):
+            if v is not None:
+                self.set(name, np.asarray(v, np.float64))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        fcol = df.column(self.get(self.features_col))
+        d = fcol.values.shape[1] if fcol.values.ndim == 2 else 1
+        x = np.asarray(
+            extract_feature_matrix(fcol, (d,), self.get(self.features_col)),
+            np.float64,
+        )
+        log_prior = self.get(self.log_prior)
+        if self.get(self.kind) == "multinomial":
+            joint = x @ self.get(self.log_theta).T + log_prior[None, :]
+        else:
+            mean, var = self.get(self.mean), self.get(self.var)
+            # (n, k): sum_d of -0.5*(log 2 pi var + (x-mu)^2/var)
+            joint = (
+                -0.5 * (
+                    ((x[:, None, :] - mean[None]) ** 2 / var[None]).sum(-1)
+                    + np.log(2 * np.pi * var).sum(-1)[None, :]
+                )
+                + log_prior[None, :]
+            )
+        m = joint.max(axis=1, keepdims=True)
+        prob = np.exp(joint - m)
+        prob /= prob.sum(axis=1, keepdims=True)
+        pred = prob.argmax(axis=1).astype(np.float64)
+        out = df.with_column(
+            self.get(self.probability_col), prob, DataType.VECTOR
+        )
+        return out.with_column(
+            self.get(self.prediction_col), pred, DataType.DOUBLE
+        )
+
+    def transform_schema(self, schema: List[Field]) -> List[Field]:
+        return schema + [
+            Field(self.get(self.probability_col), DataType.VECTOR),
+            Field(self.get(self.prediction_col), DataType.DOUBLE),
+        ]

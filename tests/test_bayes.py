@@ -1,0 +1,100 @@
+"""NaiveBayes (reference parity: DefaultHyperparams.scala:88-92 wraps
+SparkML NaiveBayes in the tuning tier)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.ml import NaiveBayes
+
+
+def test_multinomial_separates_counts():
+    rng = np.random.default_rng(0)
+    n, d = 600, 20
+    y = rng.integers(0, 2, n).astype(np.float64)
+    # class-dependent count profiles (first half of vocab vs second)
+    rates = np.where(y[:, None] > 0,
+                     np.concatenate([np.full(d // 2, 0.5), np.full(d // 2, 3.0)]),
+                     np.concatenate([np.full(d // 2, 3.0), np.full(d // 2, 0.5)]))
+    x = rng.poisson(rates).astype(np.float64)
+    df = DataFrame.from_dict({"features": x, "label": y})
+    m = NaiveBayes(smoothing=1.0).fit(df)
+    out = m.transform(df)
+    acc = (out["prediction"] == y).mean()
+    assert acc > 0.95
+    prob = np.asarray(out["probability"])
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, rtol=1e-9)
+
+
+def test_gaussian_mode_and_parity_with_sklearn():
+    from sklearn.naive_bayes import GaussianNB
+
+    rng = np.random.default_rng(1)
+    n, d = 400, 6
+    y = rng.integers(0, 3, n).astype(np.float64)
+    x = rng.normal(size=(n, d)) + y[:, None] * 1.5
+    df = DataFrame.from_dict({"features": x, "label": y})
+    m = NaiveBayes(model_type="gaussian", smoothing=0.0).fit(df)
+    pred = m.transform(df)["prediction"]
+    sk = GaussianNB().fit(x, y).predict(x)
+    assert (np.asarray(pred) == sk).mean() > 0.98
+
+
+def test_multinomial_rejects_negative():
+    df = DataFrame.from_dict(
+        {"features": np.array([[1.0, -2.0]]), "label": [0.0]}
+    )
+    with pytest.raises(ValueError, match="non-negative"):
+        NaiveBayes().fit(df)
+
+
+def test_save_load_roundtrip(tmp_path):
+    from mmlspark_tpu.core.serialize import load_stage
+
+    rng = np.random.default_rng(2)
+    x = np.abs(rng.poisson(2.0, size=(100, 8))).astype(np.float64)
+    y = rng.integers(0, 2, 100).astype(np.float64)
+    df = DataFrame.from_dict({"features": x, "label": y})
+    m = NaiveBayes().fit(df)
+    m.save(str(tmp_path / "nb"))
+    m2 = load_stage(str(tmp_path / "nb"))
+    np.testing.assert_allclose(
+        m.transform(df)["probability"], m2.transform(df)["probability"]
+    )
+
+
+def test_default_hyperparams():
+    from mmlspark_tpu.automl.hyperparam import DefaultHyperparams
+
+    entries = DefaultHyperparams.for_estimator(NaiveBayes())
+    assert [name for _, name, _ in entries] == ["smoothing"]
+
+
+def test_tune_wraps_naive_bayes():
+    from mmlspark_tpu.automl.hyperparam import DefaultHyperparams, RandomSpace
+    from mmlspark_tpu.automl.tune import TuneHyperparameters
+
+    rng = np.random.default_rng(3)
+    x = rng.poisson(2.0, size=(200, 10)).astype(np.float64)
+    y = (x[:, 0] > x[:, 1]).astype(np.float64)
+    df = DataFrame.from_dict({"features": x, "label": y})
+    nb = NaiveBayes()
+    space = RandomSpace(DefaultHyperparams.for_estimator(nb), seed=0)
+    tuned = TuneHyperparameters(
+        models=[nb], param_space=space, evaluation_metric="accuracy",
+        number_of_folds=2, num_runs=3, parallelism=1, seed=0,
+    ).fit(df)
+    assert (tuned.transform(df)["prediction"] == y).mean() > 0.7
+
+
+def test_zero_smoothing_has_finite_probabilities():
+    """alpha=0 (the DefaultHyperparams grid's lower bound) must not produce
+    NaN probabilities via log(0) on zero-count cells."""
+    rng = np.random.default_rng(4)
+    x = rng.poisson(1.0, size=(60, 12)).astype(np.float64)
+    x[:, 5] = 0.0  # a feature with zero counts in every class
+    y = rng.integers(0, 2, 60).astype(np.float64)
+    df = DataFrame.from_dict({"features": x, "label": y})
+    m = NaiveBayes(smoothing=0.0).fit(df)
+    prob = np.asarray(m.transform(df)["probability"])
+    assert np.isfinite(prob).all()

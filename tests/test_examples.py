@@ -66,6 +66,7 @@ def test_serve_entrypoint_round_trip(tmp_path):
         import threading
 
         seen: list = []
+        settled = threading.Event()  # came up OR died (fail fast on crash)
         came_up = threading.Event()
 
         def pump():
@@ -73,12 +74,15 @@ def test_serve_entrypoint_round_trip(tmp_path):
                 seen.append(ln)
                 if "serving" in ln:
                     came_up.set()
+                    settled.set()
+            settled.set()  # EOF: the entrypoint exited
 
         t = threading.Thread(target=pump, daemon=True)
         t.start()
         # the reader thread enforces the deadline even if the entrypoint
         # hangs without printing (readline itself has no timeout)
-        assert came_up.wait(timeout=60), (
+        settled.wait(timeout=60)
+        assert came_up.is_set(), (
             f"entrypoint never came up; output:\n{''.join(seen)[-2000:]}"
         )
         line = next(ln for ln in seen if "serving" in ln)

@@ -456,6 +456,14 @@ def _lgbr():
     return LightGBMRegressor(num_iterations=3, num_leaves=4), default_df()
 
 
+@fuzzer("mmlspark_tpu.ml.bayes.NaiveBayes")
+def _nb():
+    from mmlspark_tpu.ml import NaiveBayes
+
+    # gaussian: default_df features are signed (multinomial needs counts)
+    return NaiveBayes(model_type="gaussian"), default_df()
+
+
 @fuzzer("mmlspark_tpu.ml.forest.RandomForestClassifier")
 def _rfc():
     from mmlspark_tpu.ml import RandomForestClassifier
@@ -759,6 +767,8 @@ MODEL_OF = {
         "mmlspark_tpu.gbdt.estimators.LightGBMRegressor",
     "mmlspark_tpu.ml.classical.LogisticRegressionModel":
         "mmlspark_tpu.ml.classical.LogisticRegression",
+    "mmlspark_tpu.ml.bayes.NaiveBayesModel":
+        "mmlspark_tpu.ml.bayes.NaiveBayes",
     "mmlspark_tpu.ml.classical.LinearRegressionModel":
         "mmlspark_tpu.ml.classical.LinearRegression",
     "mmlspark_tpu.recommendation.indexer.RecommendationIndexerModel":

@@ -39,6 +39,12 @@ def main(argv=None) -> int:
     from mmlspark_tpu.core.serialize import load_stage
     from mmlspark_tpu.serving import DistributedServingServer, serve_pipeline
 
+    # Block shutdown signals BEFORE any server threads spawn: masks are
+    # per-thread and inherited at creation, and a process-directed SIGTERM
+    # delivered to an unblocked worker thread would kill the process before
+    # stop() can drain.
+    signal.pthread_sigmask(signal.SIG_BLOCK, {signal.SIGINT, signal.SIGTERM})
+
     schema = None
     if args.input_schema:
         schema = {
@@ -71,9 +77,6 @@ def main(argv=None) -> int:
         ).start()
 
     print(f"serving {args.model} at {server.url}", flush=True)
-    # block the signals so sigwait receives them (otherwise SIGTERM's
-    # default disposition kills the process before stop() can drain)
-    signal.pthread_sigmask(signal.SIG_BLOCK, {signal.SIGINT, signal.SIGTERM})
     signal.sigwait({signal.SIGINT, signal.SIGTERM})
     server.stop()
     return 0
