@@ -8,9 +8,16 @@ LightGBMUtils.scala:147-155). Must set env before the first jax import.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# MMLSPARK_TPU_TEST_TPU=1 opts into the attached hardware backend (for the
+# TPU-only kernel parity tests, tests/test_tpu_kernels.py); default is the
+# 8-virtual-CPU-device mesh.
+_USE_TPU = os.environ.get("MMLSPARK_TPU_TEST_TPU", "").lower() in (
+    "1", "true", "yes"
+)
+if not _USE_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
+if not _USE_TPU and "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -20,7 +27,8 @@ import jax  # noqa: E402
 # A sitecustomize may re-register a hardware backend and force
 # jax_platforms="axon,cpu"; tests must run on the 8 virtual CPU devices, so
 # re-pin the platform list after import (before any backend initializes).
-jax.config.update("jax_platforms", "cpu")
+if not _USE_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the fused GBDT grower costs ~8s of XLA
 # compile per (num_leaves, F, B) config; caching across test runs keeps the

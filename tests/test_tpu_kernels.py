@@ -1,0 +1,108 @@
+"""TPU-only kernel parity tests.
+
+These execute only when a real TPU backend is attached (they exercise the
+Pallas fast paths that CPU CI cannot compile); on the CPU mesh they skip.
+The equivalent CPU-side guarantees are the einsum-path tests in
+tests/test_gbdt.py plus the driver's dryrun tree-identity checks.
+"""
+
+import numpy as np
+import pytest
+
+
+def _tpu():
+    import jax
+
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(not _tpu(), reason="needs a real TPU backend")
+
+
+def test_pallas_hist_matches_einsum():
+    import jax
+
+    from mmlspark_tpu.gbdt import compute
+
+    rng = np.random.default_rng(0)
+    n, F, B = 4096, 14, 256
+    bins = rng.integers(0, B, size=(n, F)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.random(n).astype(np.float32)
+    mask = rng.random(n) < 0.7
+    he = np.asarray(jax.jit(
+        lambda *a: compute._hist_masked(*a, B, None, "einsum")
+    )(bins, g, h, mask))
+    hp = np.asarray(jax.jit(
+        lambda *a: compute._hist_masked(*a, B, None, "pallas")
+    )(bins, g, h, mask))
+    # g/h: both accumulate exact bf16 products in f32 but in different
+    # orders (blocked vs single contraction) — tight tolerance, not bitwise
+    np.testing.assert_allclose(he[..., :2], hp[..., :2], rtol=1e-6, atol=1e-6)
+    # counts are integer-exact either way
+    np.testing.assert_array_equal(he[..., 2], hp[..., 2])
+
+
+def test_pallas_fit_matches_einsum_trees():
+    """Whole fits through the pallas kernel and the einsum path must grow
+    IDENTICAL trees (the backend-independence contract)."""
+    import jax
+
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+
+    if jax.device_count() > 1:
+        pytest.skip(
+            "multi-device hosts shard the fit and take the einsum path on "
+            "both sides — the pallas comparison needs a single device"
+        )
+
+    rng = np.random.default_rng(1)
+    n, f = 20_000, 10
+    x = rng.normal(size=(n, f))
+    x[:, f - 2] = rng.integers(0, 12, n)
+    y = ((x[:, 0] + 0.5 * x[:, 1] * x[:, 2]) > 0).astype(np.float64)
+    df = DataFrame.from_dict({"features": x, "label": y})
+
+    def fit():
+        return LightGBMClassifier(
+            num_iterations=15, num_leaves=15,
+            categorical_slot_indexes=[f - 2], verbosity=0,
+        ).fit(df).get_booster()
+
+    bp = fit()  # pallas (tpu, single device)
+    orig = jax.default_backend
+    jax.default_backend = lambda: "cpu"  # force the einsum branch
+    try:
+        be = fit()
+    finally:
+        jax.default_backend = orig
+    assert len(bp.trees) == len(be.trees)
+    for a, b in zip(bp.trees, be.trees):
+        assert a.split_feature == b.split_feature
+        np.testing.assert_allclose(a.leaf_value, b.leaf_value, rtol=1e-6)
+
+
+def test_device_walk_against_host_reference_at_scale():
+    """The chunked device tree walk must agree with the host reference walk
+    at a shape in the class XLA once miscompiled (BASELINE.md round 5)."""
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+
+    rng = np.random.default_rng(2)
+    n, f = 60_000, 8
+    x = rng.normal(size=(n, f))
+    y = (x[:, 0] > 0).astype(np.float64)
+    df = DataFrame.from_dict({"features": x, "label": y})
+    b = LightGBMClassifier(num_iterations=40, num_leaves=15,
+                           verbosity=0).fit(df).get_booster()
+    xt = np.ascontiguousarray(x[:50_000], np.float32)
+    packed = b._pack()
+    # _walk_device directly: _walk_all would silently fall back to the host
+    # walk on a detected mismatch, making this test pass vacuously
+    dev = b._walk_device(xt, packed)
+    ref = b._walk_numpy(xt[:512], packed)
+    np.testing.assert_allclose(dev[:512], ref, rtol=1e-5, atol=1e-6)
