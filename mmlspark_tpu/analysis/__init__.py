@@ -1,0 +1,30 @@
+"""graftcheck — framework-aware static analysis for mmlspark_tpu.
+
+Three rule families, wired into tier-1 (tests/test_static_analysis.py) and
+exposed as a CLI (tools/lint.py):
+
+1. jit-safety (AST): every function reachable from a `@jax.jit`/`pjit`
+   callable is checked for host-sync anti-patterns — `.item()`/`float()` on
+   traced values, `np.*` on traced arrays, Python `if`/`while` on traced
+   values, `print` inside jit (jit_safety.py).
+2. Params contracts (reflection): every registered stage's Param metadata is
+   machine-checked — explicit converter, docstring, converter-stable default,
+   serialize round-trip, registry completeness, committed docs/api freshness
+   (params_contract.py). This enforces core/params.py's "single source of
+   truth" claim the same way the reference's codegen reflects over Spark
+   Params (CodeGen.scala:44-98).
+3. schema flow (AST): pipeline constructions in examples/ and tests/ must
+   chain — no stage consumes a column that only a later stage produces, and
+   no constructor call names a param the stage doesn't declare
+   (schema_flow.py).
+
+Suppression: append `# graftcheck: ignore[rule]` to the flagged line, with a
+justification comment. Configuration lives in pyproject.toml
+`[tool.graftcheck]` (docs/static-analysis.md).
+"""
+
+from mmlspark_tpu.analysis.base import Finding, RULES
+from mmlspark_tpu.analysis.config import GraftcheckConfig, load_config
+from mmlspark_tpu.analysis.runner import run_all
+
+__all__ = ["Finding", "RULES", "GraftcheckConfig", "load_config", "run_all"]

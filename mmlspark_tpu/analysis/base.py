@@ -1,0 +1,84 @@
+"""Shared analysis plumbing: findings, the rule catalog, suppressions.
+
+Suppression contract (tested in tests/test_static_analysis.py): a finding on
+physical line N is dropped when line N carries `# graftcheck: ignore[rule]`
+(or a bare `# graftcheck: ignore` to silence every rule on that line).
+Suppressions are line-scoped on purpose — a file-wide opt-out belongs in the
+`[tool.graftcheck]` exclude list where it is visible in review.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set
+
+# rule id -> one-line description (the CLI's --list-rules output and the
+# docs/static-analysis.md source of truth)
+RULES: Dict[str, str] = {
+    # jit-safety family (jit_safety.py)
+    "jit-host-item": ".item()/.tolist() on a traced value inside jit forces a host sync",
+    "jit-host-cast": "float()/int()/bool() on a traced value inside jit forces a host sync",
+    "jit-numpy-call": "np.* call on a traced value inside jit falls back to host numpy",
+    "jit-traced-branch": "Python if/while on a traced value inside jit raises TracerBoolConversionError",
+    "jit-print": "print() inside jit runs at trace time, not per call; use jax.debug.print",
+    # hygiene family (hygiene.py)
+    "broad-except": "bare except/except Exception that neither re-raises nor records the error",
+    # Params-contract family (params_contract.py)
+    "param-converter": "simple Param declared without an explicit type converter",
+    "param-doc": "stage or Param missing documentation",
+    "param-default": "Param default does not survive its own type converter",
+    "stage-roundtrip": "stage does not round-trip through core/serialize.py",
+    "registry-export": "public Transformer/Estimator export missing from the stage registry",
+    "docs-drift": "committed docs/api/ pages drifted from live Params metadata",
+    # schema-flow family (schema_flow.py)
+    "schema-chain": "pipeline stage consumes a column only a later stage produces",
+    "schema-unknown-param": "stage constructor call names a param the stage does not declare",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str       # repo-relative where possible
+    line: int       # 1-based; 0 for whole-file/reflective findings
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftcheck:\s*ignore(?:\[([A-Za-z0-9_,\- ]*)\])?"
+)
+
+
+def parse_suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    """{1-based line -> rule-id set, or None meaning all rules}."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        if m.group(1) is None or not m.group(1).strip():
+            out[i] = None
+        else:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def apply_suppressions(
+    findings: List[Finding], sources: Dict[str, str]
+) -> List[Finding]:
+    """Drop findings whose line carries a matching inline suppression.
+    `sources` maps finding paths to file contents (unparsed files skip)."""
+    by_path: Dict[str, Dict[int, Optional[Set[str]]]] = {}
+    kept = []
+    for f in findings:
+        if f.path not in by_path:
+            src = sources.get(f.path)
+            by_path[f.path] = parse_suppressions(src) if src is not None else {}
+        rules = by_path[f.path].get(f.line, ...)
+        if rules is ... or (rules is not None and f.rule not in rules):
+            kept.append(f)
+    return kept

@@ -1,0 +1,117 @@
+"""graftcheck configuration: the `[tool.graftcheck]` table in pyproject.toml.
+
+Recognized keys (all optional):
+
+    disable = ["rule-id", ...]     # rules to skip entirely
+    exclude = ["path/prefix", ...] # repo-relative path prefixes to skip
+
+Parsed with tomllib/tomli when available; otherwise a minimal line parser
+that understands exactly the shape above (string lists under one table) so
+the analyzer has zero hard dependencies beyond the standard library.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class GraftcheckConfig:
+    disable: List[str] = field(default_factory=list)
+    exclude: List[str] = field(default_factory=list)
+    root: str = "."
+
+    def path_excluded(self, rel_path: str) -> bool:
+        rel = rel_path.replace(os.sep, "/")
+        return any(
+            rel == e or rel.startswith(e.rstrip("/") + "/")
+            for e in self.exclude
+        )
+
+
+def find_repo_root(start: str) -> Optional[str]:
+    """Nearest ancestor of `start` containing pyproject.toml."""
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.exists(os.path.join(cur, "pyproject.toml")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def _parse_toml(text: str) -> dict:
+    try:
+        import tomllib  # py311+
+        return tomllib.loads(text)
+    except ImportError:
+        pass
+    try:
+        import tomli
+        return tomli.loads(text)
+    except ImportError:
+        return _mini_toml(text)
+
+
+def _mini_toml(text: str) -> dict:
+    """Tiny fallback: tables of `key = ["str", ...]` / `key = "str"` /
+    booleans. Enough for [tool.graftcheck]; anything fancier needs tomllib."""
+    out: dict = {}
+    table: dict = out
+    buf = ""
+    key = None
+    for raw in text.splitlines():
+        line = raw.strip()
+        if buf:  # continuation of a multi-line list
+            buf += " " + line
+            if "]" in line:
+                table[key] = re.findall(r'"((?:[^"\\]|\\.)*)"', buf)
+                buf, key = "", None
+            continue
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"\[([^\]]+)\]$", line)
+        if m:
+            table = out
+            for part in m.group(1).split("."):
+                table = table.setdefault(part.strip().strip('"'), {})
+            continue
+        m = re.match(r"([A-Za-z0-9_-]+)\s*=\s*(.+)$", line)
+        if not m:
+            continue
+        k, v = m.group(1), m.group(2).split("#")[0].strip()
+        if v.startswith("[") and "]" not in v:
+            buf, key = v, k
+            continue
+        if v.startswith("["):
+            table[k] = re.findall(r'"((?:[^"\\]|\\.)*)"', v)
+        elif v in ("true", "false"):
+            table[k] = v == "true"
+        elif v.startswith('"'):
+            table[k] = v.strip('"')
+        else:
+            try:
+                table[k] = int(v)
+            except ValueError:
+                table[k] = v
+    return out
+
+
+def load_config(root: Optional[str] = None) -> GraftcheckConfig:
+    if root is None:
+        root = find_repo_root(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))) or "."
+    cfg = GraftcheckConfig(root=root)
+    pyproject = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(pyproject):
+        return cfg
+    with open(pyproject, encoding="utf-8") as f:
+        data = _parse_toml(f.read())
+    table = data.get("tool", {}).get("graftcheck", {})
+    cfg.disable = [str(x) for x in table.get("disable", [])]
+    cfg.exclude = [str(x) for x in table.get("exclude", [])]
+    return cfg

@@ -1,0 +1,75 @@
+"""Hygiene rules: error-swallowing except handlers.
+
+`broad-except` flags a handler that catches everything (bare `except:`,
+`except Exception` / `except BaseException`) AND makes the failure
+invisible: the body neither re-raises nor references the bound exception
+(logging it, attaching it to a row, wrapping it). That combination is how
+the io/image.py:83 class of bug ships — a decode error becomes a silently
+shorter DataFrame. Handlers that record or re-raise are fine; genuinely
+intentional swallows take a justified `# graftcheck: ignore[broad-except]`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from mmlspark_tpu.analysis.base import Finding
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e for e in t.elts]
+    else:
+        names = [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in _BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _BROAD:
+            return True
+    return False
+
+
+def _handler_visible(handler: ast.ExceptHandler) -> bool:
+    """True when the handler surfaces the error: re-raises, or binds the
+    exception and actually uses it."""
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if (
+            handler.name
+            and isinstance(node, ast.Name)
+            and node.id == handler.name
+        ):
+            return True
+    return False
+
+
+def check_broad_except(paths: List[str], repo_root: Optional[str] = None) -> List[Finding]:
+    repo_root = repo_root or os.getcwd()
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, repo_root)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node) and not _handler_visible(node):
+                findings.append(Finding(
+                    "broad-except", rel, node.lineno,
+                    "broad except swallows the error; catch the specific "
+                    "types, or record/re-raise the exception",
+                ))
+    return findings

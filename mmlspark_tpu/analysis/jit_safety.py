@@ -1,0 +1,492 @@
+"""jit-safety: AST pass over every function reachable from a jit/pjit root.
+
+The classic failure mode of traced-execution systems: Python that runs at
+trace time but reads traced VALUES — `.item()`, `float()`, `if x > 0` —
+either crashes (TracerBoolConversionError) or silently syncs the device and
+falls back to host execution, turning a fused XLA program into a per-call
+round trip through the chip tunnel. This pass makes those anti-patterns
+machine-checked without importing (or tracing) anything.
+
+Mechanics:
+- roots: functions decorated `@jax.jit` / `@functools.partial(jax.jit, ...)`
+  / `@pjit`, or passed by name to a `jax.jit(...)` / `pjit(...)` call
+  anywhere in the file (the `fn = jax.jit(step)` idiom).
+- call graph: bare-name and `module.name` calls are resolved against the
+  analyzed file set (same module, `from pkg.mod import fn`, `mod.fn`);
+  reachable functions are checked like roots. Dynamic dispatch
+  (`obj.method(...)`) is out of scope — by design the hot kernels here are
+  module-level functions.
+- taint: a root's parameters are traced except names listed in
+  `static_argnames`/positions in `static_argnums`; a callee's parameters are
+  traced exactly when some analyzed call site passes them a traced argument
+  (taint sets grow monotonically to a fixpoint, so shared helpers take the
+  union over their call sites). A `**kwargs` splat at a call site adds no
+  taint — the codebase convention is that splatted kwargs carry static
+  configuration. `.shape`/`.ndim`/`.dtype`/`len()` of a traced value and
+  `x is None` checks are concrete at trace time and do not propagate taint.
+  Nested `def`s (scan/while_loop bodies) are checked with their parameters
+  traced.
+
+Rules: jit-host-item, jit-host-cast, jit-numpy-call, jit-traced-branch,
+jit-print (base.RULES).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from mmlspark_tpu.analysis.base import Finding
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_SYNC_METHODS = {"item", "tolist", "to_py"}
+
+
+class _ModuleInfo:
+    """Per-file facts: imports, function defs, jit roots."""
+
+    def __init__(self, path: str, module: str, tree: ast.Module):
+        self.path = path
+        self.module = module
+        self.tree = tree
+        # local alias -> imported module path ("np" -> "numpy")
+        self.mod_aliases: Dict[str, str] = {}
+        # local name -> (module path, object name) for `from m import n`
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        # function name -> defs, for CALL-RESOLVABLE functions only: module
+        # level and nested-in-function. Methods are kept out so a method
+        # sharing a jit root's name is never analyzed as that root.
+        self.functions: Dict[str, List[ast.FunctionDef]] = {}
+        self.methods: List[ast.FunctionDef] = []
+        # (function name) -> static param names, for jit roots
+        self.roots: Dict[str, Set[str]] = {}
+        # jit-decorated methods: analyzed standalone, never name-resolved
+        self.method_roots: List[Tuple[ast.FunctionDef, Set[str]]] = []
+        self._collect()
+
+    def _collect_defs(self, node: ast.AST, in_class: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._collect_defs(child, True)
+            elif isinstance(child, ast.FunctionDef):
+                if in_class:
+                    self.methods.append(child)
+                else:
+                    self.functions.setdefault(child.name, []).append(child)
+                # defs nested under a def (scan bodies, closures) are
+                # plain functions even inside a method
+                self._collect_defs(child, False)
+            else:
+                self._collect_defs(child, in_class)
+
+    def _collect(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.mod_aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    self.from_imports[a.asname or a.name] = (node.module, a.name)
+        self._collect_defs(self.tree, False)
+        # roots from decorators
+        for defs in self.functions.values():
+            for fn in defs:
+                for deco in fn.decorator_list:
+                    statics = self._jit_statics(deco, fn)
+                    if statics is not None:
+                        self.roots.setdefault(fn.name, set()).update(statics)
+        for fn in self.methods:
+            for deco in fn.decorator_list:
+                statics = self._jit_statics(deco, fn)
+                if statics is not None:
+                    self.method_roots.append((fn, statics))
+        # roots from call form: jax.jit(fn, ...) / pjit(fn)
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            if not self._is_jit_name(node.func):
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Name) and target.id in self.functions:
+                statics = self._static_names(
+                    node, self.functions[target.id][0]
+                )
+                self.roots.setdefault(target.id, set()).update(statics)
+
+    def _is_jit_name(self, node: ast.expr) -> bool:
+        """jax.jit / jit / pjit / jax.experimental.pjit.pjit — the base must
+        resolve to a jax import, so numba.jit/torch.jit never create roots."""
+        if isinstance(node, ast.Attribute):
+            if node.attr not in ("jit", "pjit"):
+                return False
+            base = node.value
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if not isinstance(base, ast.Name):
+                return False
+            target = self.mod_aliases.get(base.id)
+            if target is None:
+                src = self.from_imports.get(base.id)
+                target = f"{src[0]}.{src[1]}" if src else None
+            return target is not None and (
+                target == "jax" or target.startswith("jax.")
+            )
+        if isinstance(node, ast.Name) and node.id in ("jit", "pjit"):
+            src = self.from_imports.get(node.id)
+            return src is not None and (
+                src[0] == "jax" or src[0].startswith("jax.")
+            )
+        return False
+
+    def _jit_statics(
+        self, deco: ast.expr, fn: ast.FunctionDef
+    ) -> Optional[Set[str]]:
+        """None if `deco` is not a jit decorator, else its static names."""
+        if self._is_jit_name(deco):
+            return set()
+        if isinstance(deco, ast.Call):
+            # functools.partial(jax.jit, ...) — statics ride the partial
+            f = deco.func
+            is_partial = (
+                isinstance(f, ast.Attribute) and f.attr == "partial"
+            ) or (isinstance(f, ast.Name) and f.id == "partial")
+            if is_partial and deco.args and self._is_jit_name(deco.args[0]):
+                return self._static_names(deco, fn)
+            if self._is_jit_name(f):  # @jax.jit(static_argnames=...)
+                return self._static_names(deco, fn)
+        return None
+
+    def _static_names(self, call: ast.Call, fn: ast.FunctionDef) -> Set[str]:
+        out: Set[str] = set()
+        pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                        out.add(n.value)
+            elif kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                        if 0 <= n.value < len(pos):
+                            out.add(pos[n.value])
+        return out
+
+
+def _package_modules(package_dir: str, package_name: str):
+    """Yield (path, dotted module name) for every .py under the package."""
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = [d for d in dirnames if not d.startswith((".", "__pycache__"))]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, package_dir)
+            parts = rel[:-3].replace(os.sep, "/").split("/")
+            if parts[-1] == "__init__":
+                parts = parts[:-1]
+            yield path, ".".join([package_name] + parts) if parts else package_name
+
+
+class _Taint:
+    """Intra-function taint: which local names hold traced values."""
+
+    def __init__(self, tainted: Set[str]):
+        self.names = set(tainted)
+
+    def expr(self, node: ast.expr) -> bool:
+        """True when evaluating `node` can yield a traced value."""
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in _SHAPE_ATTRS:
+                return False  # concrete at trace time
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "len":
+                return False  # len of a traced array is static
+            return any(self.expr(c) for c in ast.iter_child_nodes(node))
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            return False  # identity checks are concrete under tracing
+        return any(
+            self.expr(c) for c in ast.iter_child_nodes(node)
+            if isinstance(c, ast.expr)
+        )
+
+    def assign(self, target: ast.expr) -> None:
+        """Taint the names a store binds: `x`, `(a, b)`, `x[i]` (x, not the
+        index i — it stays a read), `x.attr` (x)."""
+        if isinstance(target, ast.Name):
+            self.names.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self.assign(e)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value)
+        elif isinstance(target, (ast.Subscript, ast.Attribute)):
+            v = target.value
+            while isinstance(v, (ast.Subscript, ast.Attribute)):
+                v = v.value
+            if isinstance(v, ast.Name):
+                self.names.add(v.id)
+
+
+def _root_taint(fn: ast.FunctionDef, static_names: Set[str]) -> Set[str]:
+    """Traced parameter names of a jit root: everything not declared static."""
+    a = fn.args
+    tainted = {
+        arg.arg for arg in a.posonlyargs + a.args + a.kwonlyargs
+        if arg.arg not in static_names and arg.arg != "self"
+    }
+    if a.vararg:
+        tainted.add(a.vararg.arg)
+    return tainted
+
+
+def _callsite_taint(
+    call: ast.Call, callee: ast.FunctionDef, taint: "_Taint"
+) -> Set[str]:
+    """Callee parameter names that receive a traced argument at `call`."""
+    a = callee.args
+    pos = [x.arg for x in a.posonlyargs + a.args]
+    out: Set[str] = set()
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            # position mapping breaks at a splat: taint the remaining
+            # positional params when the splatted value is traced
+            if taint.expr(arg.value):
+                out.update(pos[i:])
+                if a.vararg:
+                    out.add(a.vararg.arg)
+            break
+        if not taint.expr(arg):
+            continue
+        if i < len(pos):
+            out.add(pos[i])
+        elif a.vararg:
+            out.add(a.vararg.arg)
+    for kw in call.keywords:
+        # kw.arg None (**splat) intentionally adds nothing: splatted kwargs
+        # are static configuration by convention here
+        if kw.arg and taint.expr(kw.value):
+            out.add(kw.arg)
+    return out
+
+
+def _check_function(
+    fn: ast.FunctionDef,
+    tainted_params: Set[str],
+    *,
+    rel_path: str,
+    np_aliases: Set[str],
+    findings: List[Finding],
+) -> "_Taint":
+    taint = _Taint(tainted_params)
+
+    body_nodes: List[ast.stmt] = list(fn.body)
+
+    def propagate(stmts: List[ast.stmt]) -> None:
+        for node in ast.walk(ast.Module(body=stmts, type_ignores=[])):
+            if isinstance(node, ast.Assign) and taint.expr(node.value):
+                for t in node.targets:
+                    taint.assign(t)
+            elif isinstance(node, ast.AugAssign) and (
+                taint.expr(node.value) or taint.expr(node.target)
+            ):
+                taint.assign(node.target)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                    and taint.expr(node.value):
+                taint.assign(node.target)
+            elif isinstance(node, ast.For) and taint.expr(node.iter):
+                taint.assign(node.target)
+            elif isinstance(node, (ast.FunctionDef, ast.Lambda)):
+                # nested callables (scan/while bodies): their params are
+                # traced when called by lax control flow
+                for arg in node.args.posonlyargs + node.args.args:
+                    taint.names.add(arg.arg)
+
+    # to a fixpoint: a loop can chain assignments (c = b; b = a; a = x), so
+    # one name can need as many passes as the chain is deep
+    while True:
+        before = len(taint.names)
+        propagate(body_nodes)
+        if len(taint.names) == before:
+            break
+
+    for node in ast.walk(ast.Module(body=body_nodes, type_ignores=[])):
+        line = getattr(node, "lineno", fn.lineno)
+        if isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            test = node.test
+            if taint.expr(test):
+                kind = type(node).__name__.lower()
+                findings.append(Finding(
+                    "jit-traced-branch", rel_path, line,
+                    f"`{kind}` on a traced value in jit-reachable "
+                    f"`{fn.name}`; use jnp.where/lax.cond/lax.while_loop",
+                ))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "print":
+                findings.append(Finding(
+                    "jit-print", rel_path, line,
+                    f"print() in jit-reachable `{fn.name}` runs at trace "
+                    "time only; use jax.debug.print",
+                ))
+            elif isinstance(f, ast.Name) and f.id in _HOST_CASTS and any(
+                taint.expr(arg) for arg in node.args
+            ):
+                findings.append(Finding(
+                    "jit-host-cast", rel_path, line,
+                    f"{f.id}() on a traced value in jit-reachable "
+                    f"`{fn.name}` forces a host sync",
+                ))
+            elif isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS \
+                    and taint.expr(f.value):
+                findings.append(Finding(
+                    "jit-host-item", rel_path, line,
+                    f".{f.attr}() on a traced value in jit-reachable "
+                    f"`{fn.name}` forces a host sync",
+                ))
+            elif (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in np_aliases
+                and any(taint.expr(arg) for arg in node.args)
+            ):
+                findings.append(Finding(
+                    "jit-numpy-call", rel_path, line,
+                    f"{f.value.id}.{f.attr}() on a traced value in "
+                    f"jit-reachable `{fn.name}` leaves the XLA program; "
+                    "use jax.numpy",
+                ))
+    return taint
+
+
+def check_jit_safety(
+    package_dir: str,
+    package_name: str = "mmlspark_tpu",
+    repo_root: Optional[str] = None,
+    excluded=None,
+) -> List[Finding]:
+    """Run the jit-safety pass over every module under `package_dir`.
+    `excluded` (repo-relative path -> bool) drops files from discovery
+    entirely — they contribute no roots, no taint, and need not parse."""
+    repo_root = repo_root or os.path.dirname(os.path.abspath(package_dir))
+    infos: Dict[str, _ModuleInfo] = {}
+    for path, module in _package_modules(package_dir, package_name):
+        if excluded is not None and excluded(os.path.relpath(path, repo_root)):
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            raise SyntaxError(f"graftcheck cannot parse {path}: {e}")
+        infos[module] = _ModuleInfo(path, module, tree)
+
+    findings: List[Finding] = []
+    # Fixpoint worklist over (module, function) keys. Roots carry their
+    # declared taint (params minus static_argnames) and keep it regardless
+    # of call sites — jit retraces per static value, so their statics are
+    # concrete. Callee taint is the union of traced arguments over every
+    # analyzed call site and only grows, so the loop terminates.
+    Key = Tuple[str, str]
+    root_keys: Set[Key] = set()
+    param_taint: Dict[Key, Set[str]] = {}
+    for module, info in infos.items():
+        for name, statics in info.roots.items():
+            defs = info.functions.get(name)
+            if not defs:
+                continue
+            key = (module, name)
+            root_keys.add(key)
+            param_taint[key] = _root_taint(defs[0], statics)
+
+    processed: Dict[Key, frozenset] = {}
+    work: List[Key] = sorted(root_keys)
+
+    def _np_aliases(info: _ModuleInfo) -> Set[str]:
+        return {
+            alias for alias, target in info.mod_aliases.items()
+            if target == "numpy" or target.startswith("numpy.")
+        }
+
+    def _propagate_calls(fn: ast.FunctionDef, taint: _Taint, info: _ModuleInfo):
+        """Merge call-site taint into callees; enqueue the ones that grew."""
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _resolve_call(node.func, info, infos)
+            if callee is None:
+                continue
+            callee_def = infos[callee[0]].functions[callee[1]][0]
+            if callee not in root_keys:
+                add = _callsite_taint(node, callee_def, taint)
+                got = param_taint.setdefault(callee, set())
+                got |= add
+            if callee not in processed or \
+                    processed[callee] != frozenset(param_taint[callee]):
+                work.append(callee)
+
+    # jit-decorated METHODS: analyzed standalone (never name-resolved, so a
+    # same-named function elsewhere can't be confused with them)
+    for module, info in infos.items():
+        rel = os.path.relpath(info.path, repo_root)
+        for fn, statics in info.method_roots:
+            taint = _check_function(
+                fn, _root_taint(fn, statics), rel_path=rel,
+                np_aliases=_np_aliases(info), findings=findings,
+            )
+            _propagate_calls(fn, taint, info)
+
+    while work:
+        key = work.pop()
+        cur = frozenset(param_taint.get(key, set()))
+        if processed.get(key) == cur:
+            continue
+        processed[key] = cur
+        module, name = key
+        info = infos[module]
+        rel = os.path.relpath(info.path, repo_root)
+        for fn in info.functions[name]:
+            taint = _check_function(
+                fn, set(cur), rel_path=rel,
+                np_aliases=_np_aliases(info), findings=findings,
+            )
+            _propagate_calls(fn, taint, info)
+
+    # a function re-processed with grown taint re-reports its findings
+    return sorted(set(findings), key=lambda f: (f.path, f.line, f.rule))
+
+
+def _resolve_call(
+    func: ast.expr, info: _ModuleInfo, infos: Dict[str, _ModuleInfo]
+) -> Optional[Tuple[str, str]]:
+    """(module, function name) for a call we can resolve statically."""
+    if isinstance(func, ast.Name):
+        name = func.id
+        if name in info.functions:
+            return (info.module, name)
+        src = info.from_imports.get(name)
+        if src and src[0] in infos and src[1] in infos[src[0]].functions:
+            return (src[0], src[1])
+        return None
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        base = func.value.id
+        # `from pkg import mod` then mod.fn(...)
+        src = info.from_imports.get(base)
+        if src:
+            mod = f"{src[0]}.{src[1]}"
+            if mod in infos and func.attr in infos[mod].functions:
+                return (mod, func.attr)
+        # `import pkg.mod as alias` then alias.fn(...)
+        target = info.mod_aliases.get(base)
+        if target and target in infos and func.attr in infos[target].functions:
+            return (target, func.attr)
+    return None

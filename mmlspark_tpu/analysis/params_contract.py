@@ -1,0 +1,256 @@
+"""Params-contract checks: make "metadata is the single source of truth" real.
+
+core/params.py promises that Params metadata drives persistence, codegen and
+fuzzing. These reflective rules turn the promise into CI-gated invariants
+over the live stage registry (the reference's build-time reflection over
+Spark Params, CodeGen.scala:44-98):
+
+- param-converter: every simple Param declares an explicit type converter
+  (TypeConverters.identity on a simple param means set() accepts anything
+  and persistence fails later, far from the bug).
+- param-doc: every stage class and every Param carries documentation —
+  the codegen surface renders straight from it.
+- param-default: every default survives its own converter unchanged, so a
+  default that set() would reject (or coerce) can't ship.
+- stage-roundtrip: every no-arg-constructible stage save/loads through
+  core/serialize.py with identical class and param maps (stages needing
+  constructor args are exercised by tests/test_fuzzing.py's factories).
+- registry-export: every public Transformer/Estimator exported from a
+  subpackage __init__ is present in core/registry.py's registry — the
+  "import failure is a bug" comment enforced, not aspirational.
+- docs-drift: the committed docs/api/ pages match a fresh
+  tools/codegen.py generation.
+
+Findings are file-level (line 0 where no better anchor exists): these rules
+check live objects, not source text.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import pkgutil
+import shutil
+import tempfile
+from typing import Dict, List, Optional, Type
+
+from mmlspark_tpu.analysis.base import Finding
+
+
+def _rel_source(cls_or_mod, repo_root: str) -> str:
+    try:
+        path = inspect.getsourcefile(cls_or_mod)
+        return os.path.relpath(path, repo_root) if path else "<unknown>"
+    except TypeError:
+        return "<unknown>"
+
+
+def _def_line(cls) -> int:
+    try:
+        return inspect.getsourcelines(cls)[1]
+    except (OSError, TypeError):
+        return 0
+
+
+def _constructible(cls) -> bool:
+    try:
+        sig = inspect.signature(cls.__init__)
+    except (TypeError, ValueError):
+        return False
+    for p in list(sig.parameters.values())[1:]:
+        if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+            continue
+        if p.default is p.empty:
+            return False
+    return True
+
+
+def check_params_contract(
+    classes: Optional[Dict[str, Type]] = None,
+    repo_root: Optional[str] = None,
+) -> List[Finding]:
+    """param-converter / param-doc / param-default / stage-roundtrip over
+    `classes` ({qualified name: class}; defaults to the full registry)."""
+    from mmlspark_tpu.core.params import TypeConverters
+    from mmlspark_tpu.core.serialize import load_stage, save_stage
+
+    if classes is None:
+        from mmlspark_tpu.core.registry import all_stage_classes
+
+        classes = all_stage_classes()
+    repo_root = repo_root or os.getcwd()
+
+    findings: List[Finding] = []
+    for name, cls in sorted(classes.items()):
+        rel = _rel_source(cls, repo_root)
+        line = _def_line(cls)
+        if not (cls.__doc__ or "").strip():
+            findings.append(Finding(
+                "param-doc", rel, line, f"{name}: missing class docstring"
+            ))
+        for p in cls.params():
+            if not (p.doc or "").strip():
+                findings.append(Finding(
+                    "param-doc", rel, line, f"{name}.{p.name}: missing param doc"
+                ))
+            if not p.is_complex and p.type_converter is TypeConverters.identity:
+                findings.append(Finding(
+                    "param-converter", rel, line,
+                    f"{name}.{p.name}: simple param without an explicit "
+                    "type converter (set() accepts anything; persistence "
+                    "fails far from the bug)",
+                ))
+
+        if not _constructible(cls):
+            continue
+        try:
+            stage = cls()
+        except Exception as e:
+            findings.append(Finding(
+                "stage-roundtrip", rel, line,
+                f"{name}: no-arg constructor raised {e!r}",
+            ))
+            continue
+
+        for p, default in stage._default_param_map.items():
+            if p.is_complex:
+                continue
+            try:
+                converted = p.type_converter(default)
+            except Exception as e:
+                findings.append(Finding(
+                    "param-default", rel, line,
+                    f"{name}.{p.name}: default {default!r} rejected by its "
+                    f"own converter ({e!r})",
+                ))
+                continue
+            if converted != default or type(converted) is not type(default):
+                findings.append(Finding(
+                    "param-default", rel, line,
+                    f"{name}.{p.name}: default {default!r} not stable under "
+                    f"its converter (-> {converted!r})",
+                ))
+
+        tmp = tempfile.mkdtemp(prefix="graftcheck_rt_")
+        try:
+            path = os.path.join(tmp, "stage")
+            save_stage(stage, path)
+            loaded = load_stage(path)
+            if type(loaded) is not type(stage):
+                findings.append(Finding(
+                    "stage-roundtrip", rel, line,
+                    f"{name}: loaded {type(loaded).__name__}",
+                ))
+            else:
+                a = {p.name: v for p, v in stage._param_map.items() if not p.is_complex}
+                b = {p.name: v for p, v in loaded._param_map.items() if not p.is_complex}
+                da = {p.name: v for p, v in stage._default_param_map.items() if not p.is_complex}
+                db = {p.name: v for p, v in loaded._default_param_map.items() if not p.is_complex}
+                if a != b or da != db:
+                    findings.append(Finding(
+                        "stage-roundtrip", rel, line,
+                        f"{name}: param maps changed across save/load "
+                        f"(set {a} -> {b}; defaults {da} -> {db})",
+                    ))
+        except Exception as e:
+            findings.append(Finding(
+                "stage-roundtrip", rel, line,
+                f"{name}: save/load raised {e!r}",
+            ))
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return findings
+
+
+def check_registry_exports(
+    package=None,
+    repo_root: Optional[str] = None,
+    modules: Optional[List] = None,
+) -> List[Finding]:
+    """Every public Transformer/Estimator reachable from a subpackage
+    __init__ must be in the registry (registry-export). `modules` overrides
+    the subpackage discovery (the analyzer's own tests inject fakes)."""
+    import mmlspark_tpu
+    from mmlspark_tpu.core.pipeline import Estimator, Transformer
+    from mmlspark_tpu.core.registry import _BASE_NAMES, all_stage_classes
+
+    package = package or mmlspark_tpu
+    repo_root = repo_root or os.getcwd()
+    registered = set(all_stage_classes().values())
+
+    findings: List[Finding] = []
+    if modules is None:
+        modules = [package]
+        for modinfo in pkgutil.iter_modules(package.__path__):
+            if not modinfo.ispkg:
+                continue
+            modules.append(
+                importlib.import_module(f"{package.__name__}.{modinfo.name}")
+            )
+    for mod in modules:
+        rel = _rel_source(mod, repo_root)
+        for name in getattr(mod, "__all__", None) or vars(mod):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name, None)
+            if not (
+                inspect.isclass(obj)
+                and issubclass(obj, (Transformer, Estimator))
+                and not inspect.isabstract(obj)
+                and obj.__name__ not in _BASE_NAMES
+            ):
+                continue
+            if obj not in registered:
+                findings.append(Finding(
+                    "registry-export", rel, 0,
+                    f"{mod.__name__} exports {name} "
+                    f"({obj.__module__}.{obj.__qualname__}) but the stage "
+                    "registry does not contain it",
+                ))
+    return findings
+
+
+def check_docs_drift(repo_root: Optional[str] = None) -> List[Finding]:
+    """Committed docs/api/ must match a fresh codegen run (docs-drift)."""
+    import importlib.util
+
+    repo_root = repo_root or os.getcwd()
+    codegen_path = os.path.join(repo_root, "tools", "codegen.py")
+    if not os.path.exists(codegen_path):
+        return []
+    # load THIS root's codegen by file path — `import codegen` would reuse
+    # whatever sys.modules cached from a different root
+    spec = importlib.util.spec_from_file_location(
+        "_graftcheck_codegen", codegen_path
+    )
+    codegen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(codegen)
+
+    pages: Dict[str, str] = codegen.generate()
+    docs_dir = os.path.join(repo_root, "docs", "api")
+    findings: List[Finding] = []
+    for fname, content in sorted(pages.items()):
+        path = os.path.join(docs_dir, fname)
+        rel = os.path.relpath(path, repo_root)
+        if not os.path.exists(path):
+            findings.append(Finding(
+                "docs-drift", rel, 0,
+                "page missing; rerun: python tools/codegen.py",
+            ))
+        else:
+            with open(path, encoding="utf-8") as f:
+                if f.read() != content:
+                    findings.append(Finding(
+                        "docs-drift", rel, 0,
+                        "page stale; rerun: python tools/codegen.py",
+                    ))
+    if os.path.isdir(docs_dir):
+        for fname in sorted(os.listdir(docs_dir)):
+            if fname.endswith(".md") and fname not in pages:
+                findings.append(Finding(
+                    "docs-drift", os.path.relpath(
+                        os.path.join(docs_dir, fname), repo_root), 0,
+                    "orphan page; rerun: python tools/codegen.py",
+                ))
+    return findings

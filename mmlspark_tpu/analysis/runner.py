@@ -1,0 +1,117 @@
+"""graftcheck orchestrator: run every rule family, apply config + suppressions.
+
+`run_all(root)` is the single entry point shared by tools/lint.py and the
+tier-1 gate (tests/test_static_analysis.py::test_package_lint_clean), so
+"the CLI is green" and "CI is green" can never disagree.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional
+
+from mmlspark_tpu.analysis.base import RULES, Finding, apply_suppressions
+from mmlspark_tpu.analysis.config import GraftcheckConfig, load_config
+
+_JIT_RULES = {
+    "jit-host-item", "jit-host-cast", "jit-numpy-call",
+    "jit-traced-branch", "jit-print",
+}
+_PARAM_RULES = {"param-converter", "param-doc", "param-default", "stage-roundtrip"}
+_SCHEMA_RULES = {"schema-chain", "schema-unknown-param"}
+
+
+def _py_files(*dirs: str) -> List[str]:
+    out = []
+    for d in dirs:
+        if not os.path.isdir(d):
+            continue
+        for dirpath, dirnames, filenames in os.walk(d):
+            dirnames[:] = [x for x in dirnames if not x.startswith((".", "__pycache__"))]
+            out.extend(
+                os.path.join(dirpath, f) for f in sorted(filenames)
+                if f.endswith(".py")
+            )
+    return out
+
+
+def _filter_paths(paths: Iterable[str], cfg: GraftcheckConfig, root: str) -> List[str]:
+    return [
+        p for p in paths
+        if not cfg.path_excluded(os.path.relpath(p, root))
+    ]
+
+
+def run_all(
+    root: Optional[str] = None,
+    select: Optional[Iterable[str]] = None,
+    disable: Optional[Iterable[str]] = None,
+    package_name: str = "mmlspark_tpu",
+) -> List[Finding]:
+    """All enabled rules over the repo at `root`; returns surviving findings.
+
+    `select` restricts to the given rules; `disable` adds to the config's
+    disable list. Unknown rule ids raise (catches typos in CI config).
+    """
+    cfg = load_config(root)
+    root = cfg.root
+    # an explicit select overrides the config's disable list (a user driving
+    # one rule must actually run it); --disable always subtracts
+    enabled = set(select) if select else set(RULES) - set(cfg.disable)
+    enabled -= set(disable or ())
+    unknown = (set(select or ()) | set(disable or ()) | set(cfg.disable)) - set(RULES)
+    if unknown:
+        raise ValueError(f"unknown graftcheck rule(s): {sorted(unknown)}")
+
+    package_dir = os.path.join(root, package_name)
+    package_files = _filter_paths(_py_files(package_dir), cfg, root)
+    flow_files = _filter_paths(
+        _py_files(os.path.join(root, "examples"), os.path.join(root, "tests")),
+        cfg, root,
+    )
+
+    findings: List[Finding] = []
+    if enabled & _JIT_RULES:
+        from mmlspark_tpu.analysis.jit_safety import check_jit_safety
+
+        findings += check_jit_safety(
+            package_dir, package_name, repo_root=root,
+            excluded=cfg.path_excluded,
+        )
+    if "broad-except" in enabled:
+        from mmlspark_tpu.analysis.hygiene import check_broad_except
+
+        findings += check_broad_except(package_files, repo_root=root)
+    if enabled & _PARAM_RULES:
+        from mmlspark_tpu.analysis.params_contract import check_params_contract
+
+        findings += check_params_contract(repo_root=root)
+    if "registry-export" in enabled:
+        from mmlspark_tpu.analysis.params_contract import check_registry_exports
+
+        findings += check_registry_exports(repo_root=root)
+    if "docs-drift" in enabled:
+        from mmlspark_tpu.analysis.params_contract import check_docs_drift
+
+        findings += check_docs_drift(repo_root=root)
+    if enabled & _SCHEMA_RULES:
+        from mmlspark_tpu.analysis.schema_flow import check_schema_flow
+
+        findings += check_schema_flow(flow_files, package_name, repo_root=root)
+
+    findings = [
+        f for f in findings
+        if f.rule in enabled and not cfg.path_excluded(f.path)
+    ]
+
+    sources: Dict[str, str] = {}
+    for f in findings:
+        if f.path not in sources:
+            full = os.path.join(root, f.path)
+            try:
+                with open(full, encoding="utf-8") as fh:
+                    sources[f.path] = fh.read()
+            except OSError:
+                pass
+    findings = apply_suppressions(findings, sources)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))

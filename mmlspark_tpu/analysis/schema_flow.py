@@ -1,0 +1,205 @@
+"""Schema-flow checks over example/test pipeline constructions.
+
+Stages declare their column contract as Params (`input_col`, `output_cols`,
+`features_col`, ...). This pass reads `Pipeline(stages=[...])` /
+`PipelineModel([...])` literals in examples/ and tests/ and verifies the
+chain: a stage may consume columns from the input data or from an earlier
+stage, but a column that only a LATER stage produces is a wiring bug that
+otherwise surfaces as a KeyError deep inside fit() (schema-chain).
+
+It also checks every resolvable stage constructor call: keyword arguments
+must name a declared Param or a real __init__ parameter, so renamed params
+can't leave examples silently broken (schema-unknown-param).
+
+Resolution is import-based: only names imported from the package in the
+scanned file are checked, so local test helpers never false-positive. A
+pipeline element we can't resolve makes the produced-column set unknowable,
+and chain checking stops at it.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import inspect
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from mmlspark_tpu.analysis.base import Finding
+
+_PIPELINE_NAMES = {"Pipeline", "PipelineModel"}
+
+
+def _class_map(tree: ast.Module, package_name: str) -> Dict[str, type]:
+    """{local name: class} for names imported from the package anywhere in
+    the file (module level or inside functions — tests import locally)."""
+    out: Dict[str, type] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ImportFrom) and node.module):
+            continue
+        if node.level != 0 or not (
+            node.module == package_name
+            or node.module.startswith(package_name + ".")
+        ):
+            continue
+        for a in node.names:
+            try:
+                mod = importlib.import_module(node.module)
+            except ImportError:
+                continue  # registry-export reports unimportable modules
+            obj = getattr(mod, a.name, None)
+            if inspect.isclass(obj):
+                out[a.asname or a.name] = obj
+    return out
+
+
+def _is_stage(cls) -> bool:
+    from mmlspark_tpu.core.pipeline import PipelineStage
+
+    return issubclass(cls, PipelineStage)
+
+
+def _ctor_kwargs_ok(cls) -> Tuple[Set[str], bool]:
+    """(accepted kwarg names, has **kwargs) for cls.__init__ + Params."""
+    accepted: Set[str] = set()
+    var_kw = False
+    try:
+        sig = inspect.signature(cls.__init__)
+        for p in list(sig.parameters.values())[1:]:
+            if p.kind is p.VAR_KEYWORD:
+                var_kw = True
+            elif p.kind is not p.VAR_POSITIONAL:
+                accepted.add(p.name)
+    except (TypeError, ValueError):
+        var_kw = True
+    if hasattr(cls, "params"):
+        accepted.update(p.name for p in cls.params())
+    return accepted, var_kw
+
+
+def _col_kwargs(cls, call: ast.Call) -> Tuple[Set[str], Set[str]]:
+    """(consumed, produced) column names from the call's string-literal
+    kwargs whose names are declared column Params of `cls` (name ending in
+    `_col`/`_cols`; `output` in the name means produced)."""
+    param_names = {p.name for p in cls.params()}
+    consumed: Set[str] = set()
+    produced: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg is None or kw.arg not in param_names:
+            continue
+        if not (kw.arg.endswith("_col") or kw.arg.endswith("_cols")):
+            continue
+        vals: List[str] = []
+        if isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+            vals = [kw.value.value]
+        elif isinstance(kw.value, (ast.List, ast.Tuple)):
+            vals = [
+                e.value for e in kw.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ]
+        (produced if "output" in kw.arg else consumed).update(vals)
+    return consumed, produced
+
+
+def check_schema_flow(
+    files: List[str],
+    package_name: str = "mmlspark_tpu",
+    repo_root: Optional[str] = None,
+) -> List[Finding]:
+    repo_root = repo_root or os.getcwd()
+    findings: List[Finding] = []
+    for path in files:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, repo_root)
+        classes = _class_map(tree, package_name)
+        if not classes:
+            continue
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # -- constructor kwarg validation -----------------------------
+            if isinstance(node.func, ast.Name) and node.func.id in classes:
+                cls = classes[node.func.id]
+                if _is_stage(cls):
+                    accepted, var_kw = _ctor_kwargs_ok(cls)
+                    for kw in node.keywords:
+                        if kw.arg is None or var_kw:
+                            continue
+                        if kw.arg not in accepted:
+                            findings.append(Finding(
+                                "schema-unknown-param", rel, node.lineno,
+                                f"{cls.__name__}({kw.arg}=...): not a "
+                                f"declared Param or __init__ argument of "
+                                f"{cls.__name__}",
+                            ))
+            # -- pipeline chain validation --------------------------------
+            if not (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _PIPELINE_NAMES
+                and node.func.id in classes
+            ):
+                continue
+            stages_expr = None
+            if node.args and isinstance(node.args[0], (ast.List, ast.Tuple)):
+                stages_expr = node.args[0]
+            for kw in node.keywords:
+                if kw.arg == "stages" and isinstance(kw.value, (ast.List, ast.Tuple)):
+                    stages_expr = kw.value
+            if stages_expr is None:
+                continue
+            findings.extend(
+                _check_chain(stages_expr, classes, rel)
+            )
+    return findings
+
+
+def _check_chain(
+    stages_expr: ast.expr, classes: Dict[str, type], rel: str
+) -> List[Finding]:
+    # first pass: per-stage (consumed, produced), None for unresolvable
+    stages: List[Optional[Tuple[Set[str], Set[str], int, str]]] = []
+    for elt in stages_expr.elts:
+        if (
+            isinstance(elt, ast.Call)
+            and isinstance(elt.func, ast.Name)
+            and elt.func.id in classes
+            and _is_stage(classes[elt.func.id])
+        ):
+            cls = classes[elt.func.id]
+            consumed, produced = _col_kwargs(cls, elt)
+            stages.append((consumed, produced, elt.lineno, cls.__name__))
+        else:
+            stages.append(None)
+
+    findings: List[Finding] = []
+    produced_later: List[Set[str]] = []
+    acc: Set[str] = set()
+    for entry in reversed(stages):
+        produced_later.append(set(acc))
+        if entry is not None:
+            acc |= entry[1]
+    produced_later.reverse()
+
+    available: Set[str] = set()   # produced by earlier resolved stages
+    opaque_seen = False           # an unresolved stage may produce anything
+    for i, entry in enumerate(stages):
+        if entry is None:
+            opaque_seen = True
+            continue
+        consumed, produced, lineno, cls_name = entry
+        if not opaque_seen:
+            for col in sorted(consumed - available):
+                if col in produced_later[i]:
+                    findings.append(Finding(
+                        "schema-chain", rel, lineno,
+                        f"{cls_name} consumes column {col!r} which only a "
+                        "later pipeline stage produces",
+                    ))
+        available |= produced
+    return findings

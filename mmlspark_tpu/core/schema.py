@@ -33,7 +33,11 @@ def make_image_row(data: np.ndarray, path: str = "") -> Dict[str, Any]:
     if data.ndim == 2:
         data = data[:, :, None]
     h, w, c = data.shape
-    mode = {1: IMAGE_MODE_CV8UC1, 3: IMAGE_MODE_CV8UC3, 4: IMAGE_MODE_CV8UC4}[c]
+    mode = {1: IMAGE_MODE_CV8UC1, 3: IMAGE_MODE_CV8UC3, 4: IMAGE_MODE_CV8UC4}.get(c)
+    if mode is None:
+        # ValueError (not a bare KeyError) so decode paths can classify it
+        # as a decode failure (io/image.DECODE_ERRORS)
+        raise ValueError(f"unsupported image channel count {c} (expect 1/3/4)")
     return {
         "path": path,
         "height": int(h),

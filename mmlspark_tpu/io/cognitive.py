@@ -329,8 +329,9 @@ class BingImageSearch(CognitiveServiceBase):
 
     def __init__(self, **kwargs: Any):
         super().__init__(**kwargs)
-        self._set_defaults(count=10, offset=0, market="en-US",
-                           image_type=None)
+        # image_type stays unset by default (get_or_default -> None): a None
+        # default would not survive its to_string converter
+        self._set_defaults(count=10, offset=0, market="en-US")
 
     def query_params(self) -> dict:
         return {

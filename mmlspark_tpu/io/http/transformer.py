@@ -158,8 +158,9 @@ class SimpleHTTPTransformer(Transformer, HTTPParams, HasInputCol, HasOutputCol,
     def __init__(self, input_col: Optional[str] = None, output_col: Optional[str] = None,
                  url: Optional[str] = None, **kwargs: Any):
         super().__init__()
+        # error_col stays unset by default (_error_col falls back to
+        # "errors"): a None default would not survive its to_string converter
         self._http_defaults([0, 50, 100, 500])
-        self._set_defaults(error_col=None)
         if input_col:
             self.set_input_col(input_col)
         if output_col:

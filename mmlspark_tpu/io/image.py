@@ -22,6 +22,35 @@ from mmlspark_tpu.io.binary import read_binary
 
 IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".ppm", ".npy")
 
+# The errors a codec actually raises on corrupt/unsupported bytes: Pillow
+# signals UnidentifiedImageError (an OSError) or truncated-stream OSErrors,
+# SyntaxError from broken PNG chunk parsing, DecompressionBombError (a
+# direct Exception subclass) past MAX_IMAGE_PIXELS; np.load raises
+# ValueError on a bad .npy header. Anything else (MemoryError,
+# KeyboardInterrupt, bugs in our own code) must propagate — a bare
+# `except Exception` here once silently swallowed every failure mode into
+# a shorter DataFrame.
+try:
+    from PIL.Image import DecompressionBombError as _BombError
+except ImportError:  # Pillow absent: raw-.npy decoding still works
+    _BombError = OSError
+DECODE_ERRORS = (OSError, ValueError, SyntaxError, _BombError)
+
+
+def invalid_image_row(path: str, error: str) -> Dict:
+    """Marker row for an undecodable image (Spark ImageSchema's invalid
+    image, `ImageSchema.invalidImageRow`): data None, dims -1, and the
+    decode failure recorded on the row so callers can see WHY."""
+    return {
+        "path": path,
+        "height": -1,
+        "width": -1,
+        "nChannels": -1,
+        "mode": -1,
+        "data": None,
+        "error": error,
+    }
+
 
 def decode_image(data: bytes, path: str = "") -> Dict:
     """bytes -> image row dict (BGR uint8)."""
@@ -66,7 +95,13 @@ def read_images(
     num_partitions: int = 1,
 ) -> DataFrame:
     """Read images under `path` into an IMAGE-schema DataFrame
-    (columns: path STRING, image STRUCT)."""
+    (columns: path STRING, image STRUCT).
+
+    drop_invalid=True drops undecodable files (Spark ImageSource semantics);
+    drop_invalid=False keeps them as invalid_image_row markers carrying the
+    decode error, so a corrupt file is visible in the output instead of a
+    silently shorter DataFrame.
+    """
     raw = read_binary(
         path, recursive=recursive, sample_ratio=sample_ratio,
         inspect_zip=inspect_zip, seed=seed, num_partitions=num_partitions,
@@ -80,9 +115,10 @@ def read_images(
         try:
             images.append(decode_image(bytes(blob), p))
             paths.append(p)
-        except Exception:
+        except DECODE_ERRORS as e:
             if not drop_invalid:
-                raise
+                images.append(invalid_image_row(p, repr(e)))
+                paths.append(p)
     img_col = np.empty(len(images), dtype=object)
     for i, im in enumerate(images):
         img_col[i] = im

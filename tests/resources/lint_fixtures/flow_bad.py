@@ -1,0 +1,28 @@
+"""Seeded schema-flow violations for graftcheck's tests (parsed, never
+imported — the constructions below would not survive execution). See
+jit_bad.py for the `# expect[...]` marker contract."""
+
+from mmlspark_tpu.core.pipeline import Pipeline
+from mmlspark_tpu.text.features import HashingTF, Tokenizer
+
+# HashingTF consumes "toks", which only the LATER Tokenizer produces
+out_of_order = Pipeline(stages=[
+    HashingTF(input_col="toks", output_col="tf", num_features=16),  # expect[schema-chain]
+    Tokenizer(input_col="text", output_col="toks"),
+])
+
+# correct order: must NOT be flagged ("text" comes from the input data)
+ok = Pipeline(stages=[
+    Tokenizer(input_col="text", output_col="toks"),
+    HashingTF(input_col="toks", output_col="tf", num_features=16),
+])
+
+# consumed column never produced anywhere: assumed to be an input-data
+# column, must NOT be flagged
+from_data = Pipeline(stages=[
+    HashingTF(input_col="pretokenized", output_col="tf", num_features=16),
+])
+
+typo = Tokenizer(inputt_col="text", output_col="toks")  # expect[schema-unknown-param]
+
+suppressed_typo = Tokenizer(inputt_col="text")  # expect-suppressed[schema-unknown-param]  # graftcheck: ignore[schema-unknown-param]

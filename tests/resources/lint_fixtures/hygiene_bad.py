@@ -1,0 +1,45 @@
+"""Seeded broad-except violations for graftcheck's tests (parsed, never
+imported). See jit_bad.py for the `# expect[...]` marker contract."""
+
+
+def silent(fn):
+    try:
+        return fn()
+    except Exception:  # expect[broad-except]
+        return None
+
+
+def silent_bare(fn):
+    try:
+        return fn()
+    except:  # noqa: E722  # expect[broad-except]
+        return None
+
+
+def records_error(fn, log):
+    try:
+        return fn()
+    except Exception as e:  # binds and uses the error: must NOT be flagged
+        log.append(repr(e))
+        return None
+
+
+def reraises(fn):
+    try:
+        return fn()
+    except Exception:  # re-raises: must NOT be flagged
+        raise
+
+
+def narrow(fn):
+    try:
+        return fn()
+    except (OSError, ValueError):  # specific types: must NOT be flagged
+        return None
+
+
+def intentional(fn):
+    try:
+        return fn()
+    except Exception:  # expect-suppressed[broad-except]  # graftcheck: ignore[broad-except]
+        return None

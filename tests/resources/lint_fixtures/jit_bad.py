@@ -1,0 +1,112 @@
+"""Seeded jit-safety violations — one per rule — for graftcheck's tests.
+
+Never imported (parsed only). An "expect" comment with the rule id in
+brackets marks a line the analyzer must flag; the "expect-suppressed"
+variant marks a line it must flag but then drop under the inline
+suppression. tests/test_static_analysis.py reads these markers, so keeping
+them on the violating line is load-bearing.
+"""
+
+import functools
+
+import jax
+import numba
+import numpy as np
+
+
+@jax.jit
+def bad_item(x):
+    return x.item()  # expect[jit-host-item]
+
+
+@jax.jit
+def bad_cast(x):
+    return float(x) + 1.0  # expect[jit-host-cast]
+
+
+@jax.jit
+def bad_numpy(x):
+    return np.sum(x)  # expect[jit-numpy-call]
+
+
+@jax.jit
+def bad_branch(x):
+    if x > 0:  # expect[jit-traced-branch]
+        return x
+    return -x
+
+
+@jax.jit
+def bad_print(x):
+    print(x)  # expect[jit-print]
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def static_ok(x, *, n):
+    if n > 3:  # static argument: must NOT be flagged
+        return x * n
+    return _helper(x, n)
+
+
+def _helper(y, m):
+    # reachable from static_ok: y is traced there, m is static there
+    if m > 0:  # call sites only pass static m: must NOT be flagged
+        return y.item()  # expect[jit-host-item]
+    return y
+
+
+def _never_jitted(z):
+    # not reachable from any jit root: host code may sync freely
+    if z > 0:
+        return float(z)
+    return z.item()
+
+
+@jax.jit
+def shape_is_concrete(x):
+    if x.shape[0] > 2:  # shapes are static under tracing: must NOT be flagged
+        return x[:2]
+    if x is None:  # identity check is concrete: must NOT be flagged
+        return x
+    return x
+
+
+@jax.jit
+def chain_in_loop(x):
+    a = b = c = 0
+    for _ in range(3):  # taint takes three passes to flow down the chain
+        c = b
+        b = a
+        a = x
+        if c > 0:  # expect[jit-traced-branch]
+            break
+    return c
+
+
+class HostSide:
+    """A method named like the jit root `bad_branch`: methods are never
+    name-resolved, so this host-side code must NOT be flagged."""
+
+    def bad_branch(self, x):
+        if x > 0:
+            return float(x)
+        return x.item()
+
+    @jax.jit
+    def traced_method(self, x):
+        return x.item()  # expect[jit-host-item]
+
+
+@numba.jit
+def numba_is_not_jax(x):
+    # other frameworks' .jit decorators are host-side: must NOT be flagged
+    if x > 0:
+        return float(x)
+    return x.item()
+
+
+@jax.jit
+def suppressed_print(x):
+    print(x)  # expect-suppressed[jit-print]  # graftcheck: ignore[jit-print]
+    return x

@@ -181,6 +181,27 @@ class TestIO:
         assert len(df) == 1
         assert df["image"][0]["height"] == 10
 
+    def test_invalid_image_recorded_on_row(self, tmp_path):
+        """drop_invalid=False keeps undecodable files as invalid-image
+        marker rows that record the decode error (drop_invalid=True drops
+        them, the Spark ImageSource contract)."""
+        img = _img(4, 4)
+        (tmp_path / "good.png").write_bytes(encode_image(make_image_row(img)))
+        (tmp_path / "bad.png").write_bytes(b"this is not a png")
+        # decodes as an array but has an unsupported channel count
+        np.save(tmp_path / "weird.npy", np.zeros((4, 4, 2), np.uint8))
+        kept = read_images(str(tmp_path), drop_invalid=False)
+        assert len(kept) == 3
+        rows = {p: r for p, r in zip(kept["path"], kept["image"])}
+        for name in ("bad.png", "weird.npy"):
+            bad = rows[str(tmp_path / name)]
+            assert bad["data"] is None and bad["height"] == -1
+            assert "error" in bad and bad["error"]
+        good = rows[str(tmp_path / "good.png")]
+        np.testing.assert_array_equal(np.asarray(good["data"]), img)
+        dropped = read_images(str(tmp_path), drop_invalid=True)
+        assert len(dropped) == 1
+
     def test_unroll_binary_image(self, tmp_path):
         img = _img(6, 6)
         data = encode_image(make_image_row(img), "png")

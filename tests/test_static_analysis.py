@@ -1,0 +1,324 @@
+"""graftcheck's own tests: every rule fires on a seeded fixture violation,
+suppressions work, and the full pass over the repo is clean (the tier-1
+gate the ROADMAP's "refactor freely" bet rides on).
+
+Fixture modules under tests/resources/lint_fixtures/ are parsed, never
+imported, and carry `# expect[rule]` / `# expect-suppressed[rule]` markers
+on their violating lines; the tests below diff analyzer output against the
+markers so fixture and assertion can't drift apart. The fixture directory
+is excluded from the package-wide pass via [tool.graftcheck] exclude."""
+
+import os
+import re
+import shutil
+import sys
+import types
+
+from mmlspark_tpu.analysis.base import (
+    RULES,
+    Finding,
+    apply_suppressions,
+    parse_suppressions,
+)
+from mmlspark_tpu.analysis.config import load_config
+from mmlspark_tpu.analysis.hygiene import check_broad_except
+from mmlspark_tpu.analysis.jit_safety import check_jit_safety
+from mmlspark_tpu.analysis.params_contract import (
+    check_docs_drift,
+    check_params_contract,
+    check_registry_exports,
+)
+from mmlspark_tpu.analysis.runner import run_all
+from mmlspark_tpu.analysis.schema_flow import check_schema_flow
+from mmlspark_tpu.core.params import Param, TypeConverters
+from mmlspark_tpu.core.pipeline import Transformer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "resources", "lint_fixtures")
+
+_EXPECT_RE = re.compile(r"#\s*expect(-suppressed)?\[([a-z\-]+)\]")
+
+
+def _expectations(fixture):
+    """((line, rule) expected to survive, (line, rule) expected suppressed)."""
+    expected, suppressed = set(), set()
+    with open(os.path.join(FIXTURES, fixture)) as f:
+        for i, line in enumerate(f, start=1):
+            m = _EXPECT_RE.search(line)
+            if m:
+                (suppressed if m.group(1) else expected).add((i, m.group(2)))
+    assert expected, f"fixture {fixture} lost its expect markers"
+    return expected, suppressed
+
+
+def _assert_matches_markers(fixture, findings):
+    """Raw findings == all markers; post-suppression == surviving markers."""
+    expected, suppressed = _expectations(fixture)
+    got = {(f.line, f.rule) for f in findings if f.path.endswith(fixture)}
+    assert got == expected | suppressed, (
+        f"{fixture}: analyzer found {sorted(got)}, "
+        f"markers say {sorted(expected | suppressed)}"
+    )
+    with open(os.path.join(FIXTURES, fixture)) as f:
+        src = f.read()
+    kept = apply_suppressions(
+        [f for f in findings if f.path.endswith(fixture)],
+        {f.path: src for f in findings if f.path.endswith(fixture)},
+    )
+    assert {(f.line, f.rule) for f in kept} == expected, (
+        f"{fixture}: suppression did not drop exactly the marked lines"
+    )
+
+
+# -- jit-safety ---------------------------------------------------------------
+
+
+def test_jit_rules_fire_and_suppress():
+    findings = check_jit_safety(FIXTURES, "lint_fixtures", repo_root=FIXTURES)
+    _assert_matches_markers("jit_bad.py", findings)
+
+
+def test_jit_rules_cover_every_family_member():
+    findings = check_jit_safety(FIXTURES, "lint_fixtures", repo_root=FIXTURES)
+    fired = {f.rule for f in findings}
+    assert {
+        "jit-host-item", "jit-host-cast", "jit-numpy-call",
+        "jit-traced-branch", "jit-print",
+    } <= fired
+
+
+def test_jit_pass_respects_excludes(tmp_path):
+    """Excluded files contribute nothing — not even a parse. A syntax error
+    in an excluded file must not abort the pass (runner feeds the config's
+    path excludes through to discovery)."""
+    pkg = tmp_path / "pkg"
+    os.makedirs(pkg)
+    (pkg / "good.py").write_text(
+        "import jax\n\n@jax.jit\ndef f(x):\n    print(x)\n    return x\n"
+    )
+    (pkg / "broken.py").write_text("def broken(:\n")
+    import pytest
+
+    with pytest.raises(SyntaxError):
+        check_jit_safety(str(pkg), "pkg", repo_root=str(tmp_path))
+    findings = check_jit_safety(
+        str(pkg), "pkg", repo_root=str(tmp_path),
+        excluded=lambda rel: rel.endswith("broken.py"),
+    )
+    assert [(f.rule, f.line) for f in findings] == [("jit-print", 5)]
+
+
+# -- hygiene ------------------------------------------------------------------
+
+
+def test_broad_except_fires_and_suppresses():
+    path = os.path.join(FIXTURES, "hygiene_bad.py")
+    findings = check_broad_except([path], repo_root=FIXTURES)
+    _assert_matches_markers("hygiene_bad.py", findings)
+
+
+# -- schema flow --------------------------------------------------------------
+
+
+def test_schema_flow_fires_and_suppresses():
+    path = os.path.join(FIXTURES, "flow_bad.py")
+    findings = check_schema_flow([path], repo_root=FIXTURES)
+    _assert_matches_markers("flow_bad.py", findings)
+
+
+# -- Params contracts (fixture classes live here: reflection needs objects) --
+
+
+class _BadParamStage(Transformer):
+    """Fixture: one seeded violation per Params-contract rule."""
+
+    undocumented = Param("undocumented", "", TypeConverters.to_int)
+    unconverted = Param("unconverted", "Simple param without a converter")
+    bad_default = Param(
+        "bad_default", "Default violates its converter", TypeConverters.to_string
+    )
+
+    def __init__(self):
+        super().__init__()
+        self._set_default("bad_default", 7)
+
+
+class _NoRoundTrip(Transformer):
+    """Fixture: a set simple param JSON can't carry fails the save."""
+
+    blob = Param("blob", "Non-serializable payload", TypeConverters.to_dict)
+
+    def __init__(self):
+        super().__init__()
+        self.set("blob", {"f": lambda: None})  # callables don't JSON
+
+
+def test_params_contract_rules_fire():
+    findings = check_params_contract(
+        classes={"fixtures._BadParamStage": _BadParamStage}, repo_root=REPO
+    )
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["param-converter", "param-default", "param-doc"], [
+        str(f) for f in findings
+    ]
+
+
+def test_stage_roundtrip_rule_fires():
+    findings = check_params_contract(
+        classes={"fixtures._NoRoundTrip": _NoRoundTrip}, repo_root=REPO
+    )
+    assert [f.rule for f in findings] == ["stage-roundtrip"], [
+        str(f) for f in findings
+    ]
+
+
+def test_params_contract_clean_control():
+    from mmlspark_tpu.stages.basic import DropColumns
+
+    assert check_params_contract(
+        classes={"mmlspark_tpu.stages.basic.DropColumns": DropColumns},
+        repo_root=REPO,
+    ) == []
+
+
+# -- registry integrity (satellite: registry.py:45 enforced) ------------------
+
+
+class _OrphanTransformer(Transformer):
+    """Fixture: a public export the registry does not contain."""
+
+
+def test_registry_export_rule_fires_on_unregistered_class():
+    fake = types.ModuleType("fake_subpkg")
+    fake.__all__ = ["OrphanTransformer"]
+    fake.OrphanTransformer = _OrphanTransformer
+    findings = check_registry_exports(modules=[fake], repo_root=REPO)
+    assert [f.rule for f in findings] == ["registry-export"]
+    assert "OrphanTransformer" in findings[0].message
+
+
+def test_every_public_stage_export_is_registered():
+    """The 'import failure is a bug' comment in core/registry.py, enforced:
+    each public Transformer/Estimator exported from mmlspark_tpu/*/__init__
+    is present in the registry."""
+    assert check_registry_exports(repo_root=REPO) == []
+
+
+# -- docs drift ---------------------------------------------------------------
+
+
+def test_docs_drift_fires_on_missing_page(tmp_path):
+    shutil.copytree(
+        os.path.join(REPO, "docs", "api"), tmp_path / "docs" / "api"
+    )
+    os.makedirs(tmp_path / "tools")
+    shutil.copy(
+        os.path.join(REPO, "tools", "codegen.py"), tmp_path / "tools"
+    )
+    os.remove(tmp_path / "docs" / "api" / "INDEX.md")
+    findings = check_docs_drift(repo_root=str(tmp_path))
+    assert any(
+        f.rule == "docs-drift" and "INDEX.md" in f.path for f in findings
+    )
+
+
+# -- config / suppression plumbing -------------------------------------------
+
+
+def test_parse_suppressions_forms():
+    src = (
+        "a = 1  # graftcheck: ignore\n"
+        "b = 2  # graftcheck: ignore[jit-print]\n"
+        "c = 3  # graftcheck: ignore[jit-print, broad-except]\n"
+        "d = 4\n"
+    )
+    sup = parse_suppressions(src)
+    assert sup[1] is None
+    assert sup[2] == {"jit-print"}
+    assert sup[3] == {"jit-print", "broad-except"}
+    assert 4 not in sup
+
+
+def test_config_loads_pyproject_table():
+    cfg = load_config(REPO)
+    assert "tests/resources/lint_fixtures" in cfg.exclude
+    assert cfg.path_excluded("tests/resources/lint_fixtures/jit_bad.py")
+    assert not cfg.path_excluded("tests/test_core.py")
+
+
+def test_mini_toml_fallback_parses_our_table():
+    from mmlspark_tpu.analysis.config import _mini_toml
+
+    data = _mini_toml(
+        '[tool.graftcheck]\ndisable = ["docs-drift"]\n'
+        'exclude = [\n  "a/b",\n  "c/d",\n]\n'
+    )
+    assert data["tool"]["graftcheck"]["disable"] == ["docs-drift"]
+    assert data["tool"]["graftcheck"]["exclude"] == ["a/b", "c/d"]
+
+
+def test_unknown_rule_id_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown graftcheck rule"):
+        run_all(root=REPO, select=["not-a-rule"])
+
+
+def test_cli_unknown_rule_is_usage_error(capsys):
+    tools_dir = os.path.join(REPO, "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import lint
+
+    assert lint.main(["--select", "not-a-rule"]) == 2
+    assert "error:" in capsys.readouterr().err
+
+
+def test_select_overrides_config_disable(tmp_path):
+    """A user driving one rule explicitly must actually run it, even when
+    the config disables it for the default pass."""
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.graftcheck]\ndisable = ["broad-except"]\n'
+    )
+    pkg = tmp_path / "pkg"
+    os.makedirs(pkg)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "bad.py").write_text(
+        "def f(fn):\n    try:\n        return fn()\n"
+        "    except Exception:\n        return None\n"
+    )
+    default = run_all(root=str(tmp_path), package_name="pkg")
+    assert [f.rule for f in default] == []
+    selected = run_all(
+        root=str(tmp_path), select=["broad-except"], package_name="pkg"
+    )
+    assert [f.rule for f in selected] == ["broad-except"]
+
+
+def test_cli_list_rules(capsys):
+    tools_dir = os.path.join(REPO, "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import lint
+
+    assert lint.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in RULES:
+        assert rule in out
+
+
+def test_finding_str_is_clickable():
+    f = Finding("jit-print", "mmlspark_tpu/x.py", 12, "boom")
+    assert str(f) == "mmlspark_tpu/x.py:12: jit-print: boom"
+
+
+# -- THE tier-1 gate ----------------------------------------------------------
+
+
+def test_package_lint_clean():
+    """`python tools/lint.py mmlspark_tpu` and this test share run_all():
+    the entire repo must pass every graftcheck rule."""
+    findings = run_all(root=REPO)
+    assert not findings, "graftcheck findings:\n" + "\n".join(
+        str(f) for f in findings
+    )

@@ -1,0 +1,77 @@
+"""graftcheck CLI: framework-aware static analysis for mmlspark_tpu.
+
+Run:  python tools/lint.py [path]            # full pass, exit 1 on findings
+      python tools/lint.py --list-rules      # rule catalog
+      python tools/lint.py --select jit-host-item,jit-print
+      python tools/lint.py --disable docs-drift
+
+The same pass gates tier-1 through
+tests/test_static_analysis.py::test_package_lint_clean; see
+docs/static-analysis.md for the rule families and the
+`# graftcheck: ignore[rule]` suppression syntax.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftcheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "path", nargs="?", default=None,
+        help="package dir or repo root to lint (default: repo containing tools/)",
+    )
+    parser.add_argument(
+        "--select", default=None,
+        help="comma-separated rule ids to run exclusively",
+    )
+    parser.add_argument(
+        "--disable", default=None,
+        help="comma-separated rule ids to skip (adds to [tool.graftcheck] disable)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog and exit"
+    )
+    args = parser.parse_args(argv)
+
+    from mmlspark_tpu.analysis import RULES, run_all
+    from mmlspark_tpu.analysis.config import find_repo_root
+
+    if args.list_rules:
+        width = max(len(r) for r in RULES)
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule:<{width}}  {desc}")
+        return 0
+
+    root = None
+    if args.path:
+        root = find_repo_root(args.path)
+        if root is None:
+            print(f"error: no pyproject.toml above {args.path}", file=sys.stderr)
+            return 2
+
+    select = args.select.split(",") if args.select else None
+    disable = args.disable.split(",") if args.disable else None
+    try:
+        findings = run_all(root=root, select=select, disable=disable)
+    except ValueError as e:
+        # unknown rule id: a usage error (exit 2), distinct from findings (1)
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"\ngraftcheck: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("graftcheck: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
