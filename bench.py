@@ -2,6 +2,12 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extras"}.
 
+`python bench.py --smoke` instead runs the CPU-safe dataplane smoke bench
+(tiny shapes; also wired into tier-1 via tests/test_bench_smoke.py): it
+measures stage-boundary transfer/compile counts for the device-resident
+columnar dataplane against the pre-change host-round-trip dataflow and
+writes BENCH_pr03.json. See run_smoke and docs/dataplane.md.
+
 Headline metric (BASELINE.json configs[1]): CIFAR10-shape ResNet-20 batch
 inference through the full product path (DataFrame -> TPUModel.transform ->
 scores column), i.e. the CNTKModel CIFAR10 notebook flow
@@ -469,6 +475,130 @@ def bench_distributed_serving():
     return triv_p50, triv_p99, model_p50, model_p99, decomp
 
 
+def run_smoke(out_path: str = "BENCH_pr03.json") -> dict:
+    """Dataplane smoke bench (CPU-safe, tiny shapes; wired into tier-1 via
+    tests/test_bench_smoke.py). Measures stage-boundary host<->device
+    TRANSFER and COMPILE counts for:
+
+    - tpu_model_chain: a fused featurize -> TPUModel chain, device-resident
+      vs the pre-change dataflow (every stage boundary materializes host
+      numpy and re-uploads). Resident transfer counts must be strictly
+      below the baseline's (ISSUE 3 acceptance).
+    - serving_ragged: 50 distinct request sizes through a two-stage serving
+      handler chain, device-resident + power-of-two bucketing vs the
+      pre-change flow (host round-trip at the interior boundary, every
+      request padded to the full max_batch). Resident transfer counts AND
+      upload bytes must be strictly below; each stage compiles at most
+      log2(max_batch)+1 = 8 programs.
+
+    Counts come from profiling.dataplane_counters() — the same meters the
+    runtime exports — so the bench measures the product path, not a mock.
+    """
+    import jax
+
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.core.dispatch import bucketing, dispatch_cache
+    from mmlspark_tpu.core.pipeline import PipelineModel
+    from mmlspark_tpu.dnn import mlp
+    from mmlspark_tpu.dnn.network import NetworkBundle
+    from mmlspark_tpu.models import TPUModel
+    from mmlspark_tpu.utils.profiling import dataplane_counters
+
+    dispatch_cache().clear()  # deterministic compile counts
+    counters = dataplane_counters()
+    rng = np.random.default_rng(0)
+
+    def tpu_stage(in_dim, out_dim, in_col, out_col, bs, seed, hidden=17):
+        net = mlp(in_dim, [hidden], out_dim)
+        bundle = NetworkBundle(net, net.init(jax.random.PRNGKey(seed)))
+        return TPUModel(bundle, input_col=in_col, output_col=out_col,
+                        mini_batch_size=bs)
+
+    # -- fused two-stage chain ------------------------------------------------
+    featurize = tpu_stage(8, 13, "features", "embedding", 32, 0)
+    head = tpu_stage(13, 4, "embedding", "scores", 32, 1)
+    pipeline = PipelineModel([featurize, head])
+    df = DataFrame.from_dict(
+        {"features": rng.normal(size=(24, 8)).astype(np.float32)}
+    )
+
+    def host_roundtrip_with(pm, frame):
+        """Pre-change dataflow: materialize host numpy at every boundary."""
+        cur = frame
+        for stage in pm.get_stages():
+            cur = stage.transform(cur)
+            cur = DataFrame.from_dict({n: np.asarray(cur[n]) for n in cur.columns})
+        return cur
+
+    pipeline.transform(df)  # warm: compiles + weight uploads
+    before = counters.snapshot()
+    out = pipeline.transform(df)
+    np.asarray(out["scores"])  # the single legitimate exit fetch
+    resident = counters.delta(before)
+
+    host_roundtrip_with(pipeline, df)  # warm
+    before = counters.snapshot()
+    out = host_roundtrip_with(pipeline, df)
+    np.asarray(out["scores"])
+    baseline = counters.delta(before)
+
+    # -- serving-style ragged batches -----------------------------------------
+    # The realistic serving handler is itself a chain (parse -> featurize ->
+    # model -> reply); 50 distinct request sizes drive it. Pre-change, every
+    # request paid the interior host round-trip AND padded to the full
+    # max_batch; post-change the interior boundary is device-resident and
+    # uploads are right-sized to the power-of-two bucket.
+    from mmlspark_tpu.models.tpu_model import _forward_key
+
+    sizes = [int(n) for n in np.random.default_rng(1).permutation(np.arange(1, 129))[:50]]
+
+    def serving_chain(hidden_a, hidden_b, seed):
+        # distinct layer widths per chain -> distinct program keys, so each
+        # pass's compile count is its own (the cache is process-wide)
+        feat = tpu_stage(6, 9, "features", "embedding", 128, seed, hidden_a)
+        hd = tpu_stage(9, 3, "embedding", "scores", 128, seed + 1, hidden_b)
+        return PipelineModel([feat, hd])
+
+    def ragged_pass(pm, roundtrip):
+        before = counters.snapshot()
+        for n in sizes:
+            frame = DataFrame.from_dict({"features": np.ones((n, 6), np.float32)})
+            scored = host_roundtrip_with(pm, frame) if roundtrip else pm.transform(frame)
+            np.asarray(scored["scores"])  # per-request reply sync
+        return counters.delta(before)
+
+    serve_pm = serving_chain(21, 23, seed=2)
+    bucketed = ragged_pass(serve_pm, roundtrip=False)
+    programs_per_stage = max(
+        dispatch_cache().distinct_programs(_forward_key(s.get_model().network))
+        for s in serve_pm.get_stages()
+    )
+    with bucketing(False):  # pre-change policy: pad every batch to the cap
+        fixed_pad = ragged_pass(serving_chain(25, 27, seed=4), roundtrip=True)
+
+    report = {
+        "pr": 3,
+        "platform": jax.default_backend(),
+        "tpu_model_chain": {
+            "rows": 24,
+            "resident": resident,
+            "baseline_host_roundtrip": baseline,
+        },
+        "serving_ragged": {
+            "distinct_sizes": len(set(sizes)),
+            "max_batch": 128,
+            "max_programs_per_stage": programs_per_stage,
+            "bucketed_resident": bucketed,
+            "baseline_fixed_pad_roundtrip": fixed_pad,
+        },
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return report
+
+
 def main() -> int:
     from mmlspark_tpu.dnn import resnet20_cifar
 
@@ -517,4 +647,7 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--smoke" in sys.argv[1:]:
+        print(json.dumps(run_smoke(), sort_keys=True))
+        sys.exit(0)
     sys.exit(main())

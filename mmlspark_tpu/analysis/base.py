@@ -24,6 +24,8 @@ RULES: Dict[str, str] = {
     "jit-print": "print() inside jit runs at trace time, not per call; use jax.debug.print",
     # hygiene family (hygiene.py)
     "broad-except": "bare except/except Exception that neither re-raises nor records the error",
+    # hot-path family (hot_path.py)
+    "host-sync-in-hot-path": "np.asarray/float()/block_until_ready on device-backed column values inside transform",
     # Params-contract family (params_contract.py)
     "param-converter": "simple Param declared without an explicit type converter",
     "param-doc": "stage or Param missing documentation",

@@ -1,0 +1,107 @@
+"""Hot-path rule: device-backed column values must not be forced to host
+inside `transform`.
+
+`host-sync-in-hot-path` flags, inside any function or method named
+`transform` (the per-batch hot path every pipeline stage runs):
+
+- ``np.asarray(X)`` / ``numpy.asarray(X)`` where X is (derived from) a
+  ``.device_values()`` call — an implicit device->host fetch that breaks the
+  device-resident chain the dataplane exists to provide (docs/dataplane.md);
+- ``float(X)`` / ``int(X)`` on such a value — a one-element fetch that still
+  pays full D2H latency (~100 ms on a tunnel-attached chip, BASELINE.md);
+- any ``.block_until_ready()`` call — a dispatch-pipeline stall; transform
+  results are consumed lazily, so the sync belongs to the final consumer,
+  not the stage.
+
+Taint is intraprocedural: names assigned from a ``device_values()`` result
+(directly or through simple name-to-name assignment) carry it. Legitimate
+boundary syncs (a host-only postprocess that MUST fetch) take a justified
+``# graftcheck: ignore[host-sync-in-hot-path]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from mmlspark_tpu.analysis.base import Finding
+
+_RULE = "host-sync-in-hot-path"
+_FETCH_CASTS = {"float", "int"}
+
+
+def _contains_device_values_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "device_values"
+        ):
+            return True
+    return False
+
+
+def _is_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    if _contains_device_values_call(node):
+        return True
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+    return False
+
+
+def _scan_transform(fn: ast.AST, rel: str, findings: List[Finding]) -> None:
+    tainted: Set[str] = set()
+    for node in ast.walk(fn):
+        # taint propagation: x = <expr touching device_values()/taint>
+        if isinstance(node, ast.Assign) and _is_tainted(node.value, tainted):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    tainted.add(tgt.id)
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "block_until_ready":
+            findings.append(Finding(
+                _RULE, rel, node.lineno,
+                "block_until_ready() inside transform stalls the dispatch "
+                "pipeline; let the consumer sync",
+            ))
+            continue
+        if not node.args:
+            continue
+        is_np_asarray = (
+            isinstance(func, ast.Attribute)
+            and func.attr == "asarray"
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("np", "numpy")
+        )
+        is_cast = isinstance(func, ast.Name) and func.id in _FETCH_CASTS
+        if (is_np_asarray or is_cast) and _is_tainted(node.args[0], tainted):
+            what = "np.asarray" if is_np_asarray else f"{func.id}()"
+            findings.append(Finding(
+                _RULE, rel, node.lineno,
+                f"{what} on a device-backed column value forces a "
+                "device->host sync inside the transform hot path",
+            ))
+
+
+def check_hot_path(paths: List[str], repo_root: Optional[str] = None) -> List[Finding]:
+    repo_root = repo_root or os.getcwd()
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, repo_root)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == "transform"
+            ):
+                _scan_transform(node, rel, findings)
+    return findings

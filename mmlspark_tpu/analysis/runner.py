@@ -82,6 +82,10 @@ def run_all(
         from mmlspark_tpu.analysis.hygiene import check_broad_except
 
         findings += check_broad_except(package_files, repo_root=root)
+    if "host-sync-in-hot-path" in enabled:
+        from mmlspark_tpu.analysis.hot_path import check_hot_path
+
+        findings += check_hot_path(package_files, repo_root=root)
     if enabled & _PARAM_RULES:
         from mmlspark_tpu.analysis.params_contract import check_params_contract
 

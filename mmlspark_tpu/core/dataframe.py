@@ -19,14 +19,42 @@ Vector columns are 2-D float arrays (n_rows, dim) — the reference's
 ml.linalg.Vector column becomes a dense matrix, which is what the TPU wants.
 Ragged data (strings, bytes, variable-length lists, image structs) uses
 object-dtype arrays and stays host-side.
+
+**Device residency (ISSUE 3)**: numeric/VECTOR columns may be
+device-backed — primary storage a `jax.Array` on HBM, host numpy
+materialized lazily only when a host-only consumer asks. Device-consuming
+stages (TPUModel, GBDT scoring, ImageFeaturizer) produce and accept
+device-backed columns, so chained stages exchange HBM handles instead of
+round-tripping through host numpy; `select`/`rename`/`with_metadata`/
+`slice`/`limit` derive zero-copy views that preserve residency. See
+docs/dataplane.md.
 """
 
 from __future__ import annotations
 
+import copy
 import enum
+import sys
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
+
+
+def is_device_array(values: Any) -> bool:
+    """True for a jax.Array (device-resident storage). Checked via
+    sys.modules so merely constructing host DataFrames never imports jax —
+    if jax was never imported, no device array can exist."""
+    if values is None or isinstance(values, np.ndarray):
+        return False
+    jax = sys.modules.get("jax")
+    return jax is not None and isinstance(values, jax.Array)
+
+
+def _counters():
+    """Lazy dataplane-counter accessor (keeps core.dataframe import-light)."""
+    from mmlspark_tpu.utils.profiling import dataplane_counters
+
+    return dataplane_counters()
 
 
 class DataType(enum.Enum):
@@ -63,6 +91,20 @@ _TYPE_TO_NUMPY = {
     DataType.BOOLEAN: np.bool_,
     DataType.TIMESTAMP: "datetime64[us]",
 }
+
+
+def _infer_device_type(values: Any) -> DataType:
+    """DataType for a device-backed (jax.Array) column. bfloat16 (an
+    accelerator compute dtype numpy has no kind for) maps to FLOAT."""
+    dt = np.dtype(values.dtype)
+    if values.ndim == 2:
+        return DataType.VECTOR
+    if dt.name == "bfloat16" or dt.kind == "V" and dt.itemsize == 2:
+        return DataType.FLOAT
+    kinds = _NUMPY_KIND_TO_TYPE.get(dt.kind)
+    if kinds is None:
+        raise TypeError(f"Cannot infer DataType for device dtype {dt}")
+    return kinds[dt.itemsize]
 
 
 def _infer_type(values: np.ndarray) -> DataType:
@@ -119,46 +161,164 @@ class Field:
         return Field(self.name, self.dtype, dict(self.metadata))
 
 
+class _ColumnStorage:
+    """Mutable (host, device) backing cell SHARED by all views of a column,
+    so a lazy sync or upload performed through any alias is visible to every
+    other alias — a rename after a model stage must not double the exit
+    fetch."""
+
+    __slots__ = ("host", "device")
+
+    def __init__(self, host: Optional[np.ndarray] = None, device: Any = None):
+        self.host = host
+        self.device = device
+
+
 class Column:
-    """A named array + type + metadata. Values is always a numpy ndarray:
-    1-D for scalars/objects, 2-D (n, dim) for VECTOR."""
+    """A named array + type + metadata.
+
+    Host storage is a numpy ndarray: 1-D for scalars/objects, 2-D (n, dim)
+    for VECTOR. A column may instead be **device-backed**: primary storage
+    is a `jax.Array` already resident on accelerator HBM (carrying whatever
+    NamedSharding it was produced under), and the host ndarray materializes
+    lazily — only when a host-only consumer asks via `.values` (object /
+    string ops, serialization, collect). Device-consuming stages chain
+    through `device_values()`, so featurize -> TPUModel -> postprocess
+    pipelines move zero bytes across the host<->HBM link between stages;
+    every sync either way is counted in profiling.dataplane_counters().
+    """
 
     def __init__(self, values: Any, dtype: Optional[DataType] = None, metadata: Optional[dict] = None):
-        if not isinstance(values, np.ndarray):
-            values = _to_array(values)
-        if dtype is None:
-            dtype = _infer_type(values)
-        if dtype == DataType.VECTOR and values.ndim != 2:
-            # rows of array-likes -> dense 2D; ragged rows (legal for Spark
-            # vector columns — e.g. per-image LIME weights with differing
-            # superpixel counts) stay as an object array of 1-D vectors.
-            # Element conversion errors still raise — only raggedness is
-            # tolerated.
-            rows = [np.asarray(v, dtype=np.float64) for v in values]
-            if len({r.shape for r in rows}) <= 1:
-                values = np.stack(rows) if rows else values
-            else:
-                ragged = np.empty(len(rows), object)
-                ragged[:] = rows
-                values = ragged
-        self.values = values
+        device = None
+        if is_device_array(values):
+            device = values
+            if dtype is None:
+                dtype = _infer_device_type(values)
+            values = None
+        else:
+            if not isinstance(values, np.ndarray):
+                values = _to_array(values)
+            if dtype is None:
+                dtype = _infer_type(values)
+            if dtype == DataType.VECTOR and values.ndim != 2:
+                # rows of array-likes -> dense 2D; ragged rows (legal for Spark
+                # vector columns — e.g. per-image LIME weights with differing
+                # superpixel counts) stay as an object array of 1-D vectors.
+                # Element conversion errors still raise — only raggedness is
+                # tolerated.
+                rows = [np.asarray(v, dtype=np.float64) for v in values]
+                if len({r.shape for r in rows}) <= 1:
+                    values = np.stack(rows) if rows else values
+                else:
+                    ragged = np.empty(len(rows), object)
+                    ragged[:] = rows
+                    values = ragged
+        self._storage = _ColumnStorage(host=values, device=device)
         self.dtype = dtype
         self.metadata = metadata or {}
 
+    # -- storage ----------------------------------------------------------
+
+    @property
+    def values(self) -> np.ndarray:
+        """Host ndarray; device-backed columns sync device->host on first
+        access (counted, shared with every view of this column), then serve
+        the cached host copy. The sync honors the declared DataType: a
+        device f32/i32 column declared DOUBLE/LONG (device compute dtypes
+        are 32-bit) widens so host consumers see the schema's dtype."""
+        storage = self._storage
+        if storage.host is None:
+            host = np.asarray(storage.device)
+            _counters().record_d2h(host.nbytes)
+            want = _TYPE_TO_NUMPY.get(self.dtype)
+            if want is not None and host.dtype != np.dtype(want) and host.dtype.kind in "fiub":
+                host = host.astype(want)
+            storage.host = host
+        return storage.host
+
+    @property
+    def is_device_backed(self) -> bool:
+        return self._storage.device is not None
+
+    def device_values(self, sharding: Any = None):
+        """The column as a device-resident jax.Array, uploading (once,
+        counted, shared with every view) if currently host-only. `sharding`
+        applies only to that first upload; an already-device column returns
+        as-is."""
+        storage = self._storage
+        if storage.device is None:
+            host = storage.host
+            if host.dtype == object:
+                raise TypeError(
+                    f"column of {self.dtype.value} is host-only (object "
+                    "dtype cannot live on device)"
+                )
+            import jax
+
+            storage.device = (
+                jax.device_put(host) if sharding is None
+                else jax.device_put(host, sharding)
+            )
+            _counters().record_h2d(host.nbytes)
+        return storage.device
+
+    @property
+    def _backing(self) -> Any:
+        s = self._storage
+        return s.host if s.host is not None else s.device
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        """Shape without forcing a host sync."""
+        return tuple(self._backing.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._backing.ndim
+
     def __len__(self) -> int:
-        return len(self.values)
+        shape = self._backing.shape
+        return int(shape[0]) if shape else 0
 
     def __repr__(self) -> str:
-        return f"Column({self.dtype.value}, n={len(self)})"
+        loc = ", device" if self.is_device_backed else ""
+        return f"Column({self.dtype.value}, n={len(self)}{loc})"
+
+    # -- derivation (zero-copy where storage allows) -----------------------
+
+    def view(self, metadata: Optional[dict] = None) -> "Column":
+        """Zero-copy view SHARING this column's storage cell (a sync or
+        upload through either alias benefits both); metadata is a deep copy
+        (of `metadata` if given, else this column's), so mutate-after-derive
+        cannot corrupt sibling frames."""
+        col = Column.__new__(Column)
+        col._storage = self._storage
+        col.dtype = self.dtype
+        col.metadata = copy.deepcopy(
+            self.metadata if metadata is None else metadata
+        )
+        return col
 
     def slice(self, start: int, stop: int) -> "Column":
-        return Column(self.values[start:stop], self.dtype, dict(self.metadata))
+        """Row slice. Host-synced columns slice as zero-copy host views;
+        device-only columns slice on device (residency preserved — a
+        host-synced column's slice re-uploads if a device stage needs it)."""
+        storage = self._storage
+        if storage.host is None:
+            col = Column.__new__(Column)
+            col._storage = _ColumnStorage(device=storage.device[start:stop])
+            col.dtype = self.dtype
+            col.metadata = copy.deepcopy(self.metadata)
+            return col
+        return Column(
+            storage.host[start:stop], self.dtype, copy.deepcopy(self.metadata)
+        )
 
     def take(self, indices: np.ndarray) -> "Column":
-        return Column(self.values[indices], self.dtype, dict(self.metadata))
+        return Column(self.values[indices], self.dtype, copy.deepcopy(self.metadata))
 
     def copy(self) -> "Column":
-        return Column(self.values, self.dtype, dict(self.metadata))
+        return self.view()
 
 
 def _to_array(values: Any) -> np.ndarray:
@@ -309,23 +469,27 @@ class DataFrame:
 
     def with_column(self, name: str, values: Any, dtype: Optional[DataType] = None,
                     metadata: Optional[dict] = None) -> "DataFrame":
-        col = values if isinstance(values, Column) else Column(values, dtype, metadata)
-        if metadata is not None and not isinstance(values, Column):
-            col.metadata = metadata
+        if isinstance(values, Column):
+            # view: shares storage, owns a deep-copied metadata dict so a
+            # later metadata mutation can't corrupt the source frame
+            col = values.view()
+        else:
+            col = Column(values, dtype, metadata)
+            if metadata is not None:
+                col.metadata = metadata
         new = dict(self._columns)
         new[name] = col
         return DataFrame(new, self.num_partitions)
 
     def with_metadata(self, name: str, metadata: dict) -> "DataFrame":
-        col = self.column(name)
         new = dict(self._columns)
-        new[name] = Column(col.values, col.dtype, dict(metadata))
+        new[name] = self.column(name).view(metadata)
         return DataFrame(new, self.num_partitions)
 
     def rename(self, existing: str, new_name: str) -> "DataFrame":
         cols = {}
         for n, c in self._columns.items():
-            cols[new_name if n == existing else n] = c
+            cols[new_name if n == existing else n] = c.view()
         return DataFrame(cols, self.num_partitions)
 
     def filter(self, mask: np.ndarray) -> "DataFrame":
@@ -491,6 +655,25 @@ class DataFrame:
     def map_partitions(self, fn: Callable[["DataFrame"], "DataFrame"]) -> "DataFrame":
         parts = [fn(p) for p in self.partitions()]
         return concat(parts).repartition(self.num_partitions)
+
+    # -- device residency ------------------------------------------------------
+
+    def to_device(self, *names: str, sharding: Any = None) -> "DataFrame":
+        """Stage the named numeric/VECTOR columns (default: all of them)
+        onto device HBM; returns a frame whose columns are device-backed so
+        downstream device-consuming stages start with zero upload cost.
+        Object-dtype columns are left host-side untouched."""
+        targets = list(names) or [
+            n for n, c in self._columns.items()
+            if (c.dtype == DataType.VECTOR or c.dtype.is_numeric)
+            and (c.is_device_backed or c.values.dtype != object)
+        ]
+        cols = dict(self._columns)
+        for n in targets:
+            col = self.column(n).view()
+            col.device_values(sharding)
+            cols[n] = col
+        return DataFrame(cols, self.num_partitions)
 
     # -- materialization -------------------------------------------------------
 

@@ -1,0 +1,211 @@
+"""Shape-bucketed compiled-dispatch cache — one XLA program per bucket.
+
+Serving traffic arrives at ragged batch sizes; jit compiles one program per
+input shape, so naive dispatch recompiles per distinct batch size (the TPU
+analog of the reference re-allocating JNI minibatch buffers per batch,
+CNTKModel.scala:71-140). This module generalizes the old per-module
+`_FWD_CACHE` in models/tpu_model.py into the process-wide policy every
+device-consuming stage shares:
+
+- **Bucketing**: row counts round up to the next power of two (capped at the
+  stage's mini_batch_size), so any traffic mix hits at most
+  ``log2(max_batch) + 1`` compiled programs. Padded rows repeat the last
+  real row (valid network inputs) and are sliced off after dispatch.
+- **Compile accounting**: the cache notes each (program, input shape) pair
+  the first time it is dispatched and reports it to
+  utils.profiling.dataplane_counters() — compiles are a measured metric
+  (bench.py --smoke), not a guess.
+- **Bounded retention**: compiled callables evict FIFO past `max_fns`, same
+  bound the old _FWD_CACHE had.
+
+`bucketing(False)` restores the pre-bucketing behavior (pad every batch to
+the full cap) — the rollback lever and the baseline bench.py --smoke
+measures against.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.utils.profiling import dataplane_counters
+
+_BUCKETING_ENABLED = True
+
+
+@contextlib.contextmanager
+def bucketing(enabled: bool) -> Iterator[None]:
+    """Scoped toggle for power-of-two bucketing (True is the default
+    behavior; False pads to the full cap — the pre-bucketing dataflow)."""
+    global _BUCKETING_ENABLED
+    prev = _BUCKETING_ENABLED
+    _BUCKETING_ENABLED = enabled
+    try:
+        yield
+    finally:
+        _BUCKETING_ENABLED = prev
+
+
+def bucket_rows(n: int, cap: Optional[int] = None) -> int:
+    """Smallest power of two >= n, capped at `cap` (cap need not be a power
+    of two — it wins, keeping mini_batch_size semantics exact)."""
+    if n <= 0:
+        return cap if cap else 1
+    if cap is not None and (n >= cap or not _BUCKETING_ENABLED):
+        return cap
+    bucket = 1 << int(n - 1).bit_length()
+    return min(bucket, cap) if cap is not None else bucket
+
+
+def pad_rows(arr: Any, target: int) -> Tuple[Any, int]:
+    """Pad axis 0 up to `target` rows by repeating the last row (padded rows
+    stay valid inputs); returns (padded, real_rows). Works for host ndarrays
+    and device jax.Arrays — the device path runs as a compiled program with
+    a static pad amount, so it is transfer-free on warm dispatch."""
+    n = int(arr.shape[0])
+    if n == 0 or n >= target:
+        return arr, n
+    if isinstance(arr, np.ndarray):
+        pad_block = np.take(arr, [-1] * (target - n), axis=0)
+        return np.concatenate([arr, pad_block], axis=0), n
+    return _pad_rows_device(arr, target=target), n
+
+
+def trim_rows(arr: Any, real: int) -> Any:
+    """Undo pad_rows: first `real` rows. Device arrays slice through a
+    compiled program (eager `arr[:real]` would promote the index scalar
+    host->device on every call, tripping jax.transfer_guard)."""
+    if int(arr.shape[0]) == real:
+        return arr
+    if isinstance(arr, np.ndarray):
+        return arr[:real]
+    return _trim_rows_device(arr, real=real)
+
+
+def slice_rows(arr: Any, start: int, stop: int) -> Any:
+    """arr[start:stop] along axis 0, transfer-free for device arrays: the
+    chunking loops in TPUModel/Booster slice device inputs through a
+    compiled program with static bounds, where eager `x[a:b]` would promote
+    its index scalars host->device on every chunk."""
+    stop = min(stop, int(arr.shape[0]))
+    if start == 0 and stop == int(arr.shape[0]):
+        return arr
+    if isinstance(arr, np.ndarray):
+        return arr[start:stop]
+    return _slice_rows_device(arr, start=start, stop=stop)
+
+
+# jit wrappers built once per process (a fresh jax.jit per call would
+# re-trace every time); jax's own cache then keys on (shape, static arg)
+_DEVICE_HELPERS: Dict[str, Callable] = {}
+
+
+def _pad_rows_device(arr, *, target: int):
+    pad = _DEVICE_HELPERS.get("pad")
+    if pad is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("t",))
+        def pad(x, *, t):
+            tail = jnp.broadcast_to(x[-1:], (t - x.shape[0],) + x.shape[1:])
+            return jnp.concatenate([x, tail], axis=0)
+
+        _DEVICE_HELPERS["pad"] = pad
+    return pad(arr, t=target)
+
+
+def _trim_rows_device(arr, *, real: int):
+    trim = _DEVICE_HELPERS.get("trim")
+    if trim is None:
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("r",))
+        def trim(x, *, r):
+            return jax.lax.slice_in_dim(x, 0, r, axis=0)
+
+        _DEVICE_HELPERS["trim"] = trim
+    return trim(arr, r=real)
+
+
+def _slice_rows_device(arr, *, start: int, stop: int):
+    sl = _DEVICE_HELPERS.get("slice")
+    if sl is None:
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("a", "b"))
+        def sl(x, *, a, b):
+            return jax.lax.slice_in_dim(x, a, b, axis=0)
+
+        _DEVICE_HELPERS["slice"] = sl
+    return sl(arr, a=start, b=stop)
+
+
+class DispatchCache:
+    """Process-wide cache of compiled callables plus per-shape compile
+    accounting. Keys are caller-chosen hashables (TPUModel uses
+    (spec, input_shape, dtype)); `compiled` builds-and-caches, `note_dispatch`
+    records the (key, shape) pairs that force an XLA compile."""
+
+    def __init__(self, max_fns: int = 32):
+        self._lock = threading.Lock()
+        self._max_fns = max_fns
+        self._fns: Dict[Any, Callable] = {}
+        self._shapes: set = set()
+
+    def compiled(self, key: Any, build: Callable[[], Callable]) -> Callable:
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is not None:
+                return fn
+        fn = build()  # build outside the lock: builders may import jax
+        with self._lock:
+            if len(self._fns) >= self._max_fns:
+                evicted = next(iter(self._fns))
+                del self._fns[evicted]
+                self._shapes = {
+                    (k, s) for k, s in self._shapes if k != evicted
+                }
+            return self._fns.setdefault(key, fn)
+
+    def note_dispatch(self, key: Any, shape: Tuple[int, ...]) -> bool:
+        """Record a dispatch of `key` at `shape`; returns True (and counts a
+        compile) the first time this program/shape pair is seen."""
+        entry = (key, tuple(int(d) for d in shape))
+        with self._lock:
+            if entry in self._shapes:
+                return False
+            self._shapes.add(entry)
+        dataplane_counters().record_compile()
+        return True
+
+    def distinct_programs(self, key: Any) -> int:
+        """How many shapes (== compiled programs) `key` has dispatched."""
+        with self._lock:
+            return sum(1 for k, _ in self._shapes if k == key)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._fns)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._fns.clear()
+            self._shapes.clear()
+
+
+_CACHE = DispatchCache()
+
+
+def dispatch_cache() -> DispatchCache:
+    """The process-wide dispatch cache singleton."""
+    return _CACHE

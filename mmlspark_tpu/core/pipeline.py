@@ -121,6 +121,12 @@ class PipelineModel(Model, Wrappable):
 
     def __init__(self, stages: Optional[Sequence[Transformer]] = None):
         super().__init__()
+        #: per-stage dataplane counter deltas from the most recent
+        #: transform(): [(stage class name, {h2d/d2h/compile deltas}), ...].
+        #: Device-resident chains show zeros at interior stage boundaries —
+        #: the measured form of "no host round-trips between device stages"
+        #: (docs/dataplane.md; surfaced by bench.py --smoke).
+        self.last_stage_dataplane: List[tuple] = []
         if stages is not None:
             self.set(self.stages_param, list(stages))
 
@@ -128,8 +134,15 @@ class PipelineModel(Model, Wrappable):
         return self.get(self.stages_param)
 
     def transform(self, df: DataFrame) -> DataFrame:
+        from mmlspark_tpu.utils.profiling import dataplane_counters
+
+        counters = dataplane_counters()
+        stats: List[tuple] = []
         for stage in self.get_stages():
+            before = counters.snapshot()
             df = stage.transform(df)
+            stats.append((type(stage).__name__, counters.delta(before)))
+        self.last_stage_dataplane = stats
         return df
 
     def transform_schema(self, schema: List[Field]) -> List[Field]:

@@ -623,10 +623,18 @@ class NetworkBundle:
     def device_variables(self):
         """Weights as device-resident arrays, uploaded once per bundle — a
         ResNet-50's ~100MB of params re-crossing the host->HBM link on every
-        transform call would dominate small-batch inference."""
+        transform call would dominate small-batch inference. The one upload
+        is counted in profiling.dataplane_counters()."""
         if self._dev_vars is None:
             import jax
 
+            from mmlspark_tpu.utils.profiling import dataplane_counters
+
+            nbytes = sum(
+                leaf.nbytes for leaf in jax.tree_util.tree_leaves(self.variables)
+                if hasattr(leaf, "nbytes")
+            )
+            dataplane_counters().record_h2d(nbytes)
             self._dev_vars = jax.device_put(self.variables)
         return self._dev_vars
 

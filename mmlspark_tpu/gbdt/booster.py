@@ -23,10 +23,17 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from mmlspark_tpu.core.dataframe import is_device_array
 from mmlspark_tpu.gbdt.objectives import Objective, make_objective
 from mmlspark_tpu.gbdt.tree import Tree, _CAT_WIDTH_CAP
 
 _MAX_CAT_VALUES = 256
+
+
+def _counters():
+    from mmlspark_tpu.utils.profiling import dataplane_counters
+
+    return dataplane_counters()
 
 
 class Booster:
@@ -55,6 +62,7 @@ class Booster:
         self.avg_output = avg_output
         self.objective_params = objective_params or {}
         self._packed = None
+        self._packed_dev = None
 
     # -- structure -------------------------------------------------------------
 
@@ -150,16 +158,36 @@ class Booster:
     _WALK_CHUNK = 131072
     _VERIFY_ROWS = 64
 
-    def _walk_device(self, x: np.ndarray, packed) -> np.ndarray:
+    def _packed_device(self):
+        """The packed ensemble as device-resident arrays, uploaded once per
+        booster (counted) — the model-side analog of
+        NetworkBundle.device_variables(); re-crossing host->HBM per predict
+        call would dominate small-batch scoring."""
+        if self._packed_dev is None:
+            packed = self._pack()
+            if packed is None:
+                return None
+            import jax
+
+            arrays = {
+                k: v for k, v in packed.items() if isinstance(v, np.ndarray)
+            }
+            _counters().record_h2d(sum(a.nbytes for a in arrays.values()))
+            self._packed_dev = dict(packed)
+            self._packed_dev.update(jax.device_put(arrays))
+        return self._packed_dev
+
+    def _walk_device(self, x):
+        """One chunk through the jit tree walk; returns the device result
+        (callers decide if/when to fetch)."""
         from mmlspark_tpu.gbdt.compute import walk_trees_raw
 
-        return np.asarray(
-            walk_trees_raw(
-                x, packed["feats"], packed["thr"], packed["is_cat"],
-                packed["cat_mask"], packed["lefts"], packed["rights"],
-                packed["is_leaf"], packed["values"],
-                max_depth=packed["max_depth"],
-            )
+        dev = self._packed_device()
+        return walk_trees_raw(
+            x, dev["feats"], dev["thr"], dev["is_cat"],
+            dev["cat_mask"], dev["lefts"], dev["rights"],
+            dev["is_leaf"], dev["values"],
+            max_depth=dev["max_depth"],
         )
 
     def _walk_numpy(self, x: np.ndarray, packed) -> np.ndarray:
@@ -189,27 +217,47 @@ class Booster:
             outs[:, i] = packed["values"][i][node]
         return outs
 
-    def _walk_all(self, x: np.ndarray, packed) -> np.ndarray:
-        """Chunked device walk with a sampled host cross-check."""
-        n = x.shape[0]
+    def _walk_all(self, x, packed):
+        """Chunked device walk with a sampled host cross-check. Device-
+        backed x stays on device throughout: chunk padding/trimming run as
+        compiled programs and only the cross-check sample (<= _VERIFY_ROWS
+        rows, counted) crosses to host."""
+        from mmlspark_tpu.core.dispatch import pad_rows, slice_rows, trim_rows
+
+        device_in = is_device_array(x)
+        n = int(x.shape[0])
         if n == 0:
             return np.zeros((0, packed["feats"].shape[0]), np.float32)
         chunks = []
         for start in range(0, n, self._WALK_CHUNK):
-            block = x[start: start + self._WALK_CHUNK]
-            real = block.shape[0]
+            # compiled static-bound slice: transfer-free for device x
+            block = slice_rows(x, start, start + self._WALK_CHUNK)
+            real = int(block.shape[0])
             if n > self._WALK_CHUNK and real < self._WALK_CHUNK:
-                block = np.concatenate(
-                    [block,
-                     np.zeros((self._WALK_CHUNK - real, x.shape[1]),
-                              np.float32)]
-                )
-            chunks.append(self._walk_device(block, packed)[:real])
-        outs = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
+                block, _ = pad_rows(block, self._WALK_CHUNK)
+            y = self._walk_device(block)
+            if not device_in:
+                y = np.asarray(y)
+                _counters().record_d2h(y.nbytes)
+            chunks.append(trim_rows(y, real))
+        if device_in:
+            import jax.numpy as jnp
+
+            outs = chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks)
+        else:
+            outs = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
         # sampled host cross-check: silent device corruption -> detected
         idx = np.linspace(0, n - 1, min(self._VERIFY_ROWS, n)).astype(int)
-        ref = self._walk_numpy(x[idx], packed)
-        if not np.allclose(outs[idx], ref, rtol=1e-5, atol=1e-6):
+        # (the idx gather on a device x uploads the index array — a bounded
+        # jax-internal transfer the counters don't meter, like the fetch
+        # below is bounded: both are <= _VERIFY_ROWS rows per predict)
+        x_sample, out_sample = x[idx], outs[idx]
+        if device_in:  # bounded, counted d2h of the sample rows only
+            x_sample = np.asarray(x_sample)
+            out_sample = np.asarray(out_sample)
+            _counters().record_d2h(x_sample.nbytes + out_sample.nbytes)
+        ref = self._walk_numpy(np.asarray(x_sample), packed)
+        if not np.allclose(out_sample, ref, rtol=1e-5, atol=1e-6):
             from mmlspark_tpu.core.config import get_logger
 
             get_logger("mmlspark_tpu.gbdt").warning(
@@ -217,19 +265,35 @@ class Booster:
                 "shape %s x %s trees; recomputing on host",
                 x.shape, packed["feats"].shape[0],
             )
-            outs = self._walk_numpy(x, packed)
+            x_host = np.asarray(x)
+            if device_in:
+                _counters().record_d2h(x_host.nbytes)
+            outs = self._walk_numpy(x_host, packed)
         return outs
 
-    def predict_raw(self, x: np.ndarray) -> np.ndarray:
-        """Margin scores. -> (n,) for single-model, (n, K) for multiclass."""
-        x = np.ascontiguousarray(np.asarray(x, np.float32))
-        n = x.shape[0]
+    def predict_raw(self, x) -> Any:
+        """Margin scores. -> (n,) for single-model, (n, K) for multiclass.
+        A device-backed (jax.Array) x produces a device-resident result —
+        the GBDT scoring stage neither downloads its input nor uploads its
+        output, so it chains with other device stages transfer-free."""
+        device_in = is_device_array(x)
+        if device_in:
+            if np.dtype(x.dtype) != np.float32:
+                x = x.astype(np.float32)  # on-device cast
+        else:
+            x = np.ascontiguousarray(np.asarray(x, np.float32))
+        n = int(x.shape[0])
         k = self.num_model_per_iter
         packed = self._pack()
         if packed is None:
             raw = np.zeros((n, k), np.float32) + self.init_score[None, :]
             return raw[:, 0] if k == 1 else raw
-        outs = self._walk_all(x, packed)  # (n, T)
+        outs = self._walk_all(x, packed)  # (n, T), device iff x was
+        xp = np
+        if is_device_array(outs):
+            import jax.numpy as jnp
+
+            xp = jnp
         if k == 1:
             raw = self.init_score[0] + outs.sum(axis=1)
             if self.avg_output:
@@ -237,20 +301,27 @@ class Booster:
                     1, self.num_iterations
                 )
             return raw
-        raw = np.tile(self.init_score[None, :], (n, 1)).astype(np.float32)
-        for c in range(k):
-            raw[:, c] += outs[:, c::k].sum(axis=1)
+        raw = self.init_score[None, :] + xp.stack(
+            [outs[:, c::k].sum(axis=1) for c in range(k)], axis=1
+        ).astype(np.float32)
         if self.avg_output:
             raw = self.init_score[None, :] + (raw - self.init_score[None, :]) / max(
                 1, self.num_iterations
             )
         return raw
 
-    def predict(self, x: np.ndarray, raw_score: bool = False) -> np.ndarray:
+    def predict(self, x, raw_score: bool = False) -> Any:
         raw = self.predict_raw(x)
         if raw_score:
             return raw
-        return self.objective().transform(raw)
+        obj = self.objective()
+        if is_device_array(raw) and type(obj).transform is not Objective.transform:
+            # non-identity output transforms are host numpy; fetch once,
+            # counted, instead of letting np.* sync implicitly
+            host = np.asarray(raw)
+            _counters().record_d2h(host.nbytes)
+            raw = host
+        return obj.transform(raw)
 
     # -- importances (LightGBMBooster.FeatureImportance semantics) -------------
 

@@ -202,8 +202,8 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
     def _fit_common(self, df: DataFrame, objective) -> Booster:
         fcol = self.get(self.features_col)
         col = df.column(fcol)
-        dim = col.values.shape[1] if col.values.ndim == 2 else 1
-        x = extract_feature_matrix(col, (dim,), fcol).astype(np.float64)
+        dim = col.shape[1] if col.ndim == 2 else 1
+        x = np.asarray(extract_feature_matrix(col, (dim,), fcol)).astype(np.float64)
         y = np.asarray(
             [float(v) for v in df.column(self.get(self.label_col)).values],
             np.float64,
@@ -345,11 +345,17 @@ class _BoosterModel(Model, HasFeaturesCol):
         """Reference: saveNativeModel (LightGBMClassifier.scala:160-185)."""
         self.get_booster().save_native_model(path, overwrite)
 
-    def _features(self, df: DataFrame) -> np.ndarray:
+    def _features(self, df: DataFrame) -> Any:
+        """Feature matrix for scoring. Device-backed input columns stay on
+        device (Booster casts on device); host columns come back as f32
+        ndarrays as before."""
         fcol = self.get(self.features_col)
         col = df.column(fcol)
-        dim = col.values.shape[1] if col.values.ndim == 2 else 1
-        return extract_feature_matrix(col, (dim,), fcol).astype(np.float32)
+        dim = col.shape[1] if col.ndim == 2 else 1
+        x = extract_feature_matrix(col, (dim,), fcol, prefer_device=True)
+        if isinstance(x, np.ndarray):
+            return x.astype(np.float32)
+        return x
 
 
 class LightGBMClassificationModel(_BoosterModel, Wrappable):
@@ -371,15 +377,28 @@ class LightGBMClassificationModel(_BoosterModel, Wrappable):
     def transform(self, df: DataFrame) -> DataFrame:
         booster = self.get_booster()
         raw = booster.predict_raw(self._features(df))
+        # device-backed features -> device raw margins; sigmoid/softmax and
+        # argmax then run on device too, producing device-backed output
+        # columns (host frames keep the numpy path and host outputs)
+        from mmlspark_tpu.core.dataframe import is_device_array
+
+        if is_device_array(raw):
+            import jax.numpy as jnp
+
+            xp: Any = jnp
+            out_f = jnp.float32  # f64 is unavailable on device; lazy host
+        else:                    # sync of `prediction` upcasts via DataType
+            xp = np
+            out_f = np.float64
         if raw.ndim == 1:  # binary: [-m, m] convention
-            raw2 = np.stack([-raw, raw], axis=1)
-            p1 = 1.0 / (1.0 + np.exp(-raw))
-            prob = np.stack([1 - p1, p1], axis=1)
+            raw2 = xp.stack([-raw, raw], axis=1)
+            p1 = 1.0 / (1.0 + xp.exp(-raw))
+            prob = xp.stack([1 - p1, p1], axis=1)
         else:
             raw2 = raw
-            e = np.exp(raw - raw.max(axis=1, keepdims=True))
+            e = xp.exp(raw - raw.max(axis=1, keepdims=True))
             prob = e / e.sum(axis=1, keepdims=True)
-        pred = prob.argmax(axis=1).astype(np.float64)
+        pred = prob.argmax(axis=1).astype(out_f)
         out = df
         if self.get(self.raw_prediction_col):
             out = out.with_column(self.get(self.raw_prediction_col), raw2, DataType.VECTOR)
@@ -403,8 +422,12 @@ class LightGBMRegressionModel(_BoosterModel, Wrappable):
         return LightGBMRegressionModel(Booster.load_native_model(path))
 
     def transform(self, df: DataFrame) -> DataFrame:
+        from mmlspark_tpu.core.dataframe import is_device_array
+
         booster = self.get_booster()
-        pred = booster.predict(self._features(df)).astype(np.float64)
+        pred = booster.predict(self._features(df))
+        if not is_device_array(pred):  # device results stay f32 on device
+            pred = pred.astype(np.float64)
         return df.with_column(self.get(self.prediction_col), pred, DataType.DOUBLE)
 
     def transform_schema(self, schema: List[Field]) -> List[Field]:

@@ -7,8 +7,14 @@ the new output node), run the inner model, emit a VECTOR column. setModel
 consumes a downloader ModelSchema (:73-77), wiring layerNames + inputNode.
 
 TPU notes: the heavy path is the inner TPUModel's jit minibatch eval
-(models/tpu_model.py) — one compiled program per (truncated spec, batch),
-bfloat16-able, windowed H2D. The featurizer itself is glue.
+(models/tpu_model.py) — one compiled program per (truncated spec, batch
+bucket), bfloat16-able, windowed H2D. The featurizer itself is glue.
+
+Dataplane: the emitted feature column is DEVICE-BACKED (the inner
+TPUModel's result stays on HBM), so `featurize -> TPUModel -> postprocess`
+chains score with zero host round-trips between stages — the image decode /
+resize / unroll prologue is host work by nature (object-dtype rows) and is
+where the single pipeline-entry upload happens. See docs/dataplane.md.
 """
 
 from __future__ import annotations

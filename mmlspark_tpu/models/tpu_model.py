@@ -24,57 +24,72 @@ from typing import List, Optional
 
 import numpy as np
 
-from mmlspark_tpu.core.dataframe import DataFrame, DataType, Field
+from mmlspark_tpu.core.dataframe import DataFrame, DataType, Field, is_device_array
+from mmlspark_tpu.core.dispatch import (
+    bucket_rows,
+    dispatch_cache,
+    pad_rows,
+    slice_rows,
+    trim_rows,
+)
 from mmlspark_tpu.core.params import ComplexParam, Param, TypeConverters, Wrappable
 from mmlspark_tpu.core.pipeline import Model
 from mmlspark_tpu.dnn.network import Network, NetworkBundle
-from mmlspark_tpu.parallel.mesh import batch_sharding, pad_to_multiple, replicated_sharding
+from mmlspark_tpu.parallel.mesh import batch_sharding, replicated_sharding
+from mmlspark_tpu.utils.profiling import dataplane_counters
 
 
-_FWD_CACHE: dict = {}
+def _forward_key(net: Network):
+    return ("tpu_model.forward", str(net.spec), str(net.input_shape), net.compute_dtype)
 
 
 def _compiled_forward(net: Network):
-    """Process-wide jit cache keyed by (spec, input_shape, dtype) so every
-    TPUModel instance wrapping the same network shares one compiled program."""
-    key = (str(net.spec), str(net.input_shape), net.compute_dtype)
-    fn = _FWD_CACHE.get(key)
-    if fn is None:
+    """Shared compiled forward, keyed by (spec, input_shape, dtype) in the
+    process-wide core.dispatch cache so every TPUModel instance wrapping the
+    same network shares one jit wrapper (and its per-bucket programs)."""
+
+    def build():
         import jax
 
         def fwd(variables, x):
             return net.apply(variables, x)
 
-        fn = jax.jit(fwd)
-        if len(_FWD_CACHE) >= 32:  # bound retained traces
-            _FWD_CACHE.pop(next(iter(_FWD_CACHE)))
-        _FWD_CACHE[key] = fn
-    return fn
+        return jax.jit(fwd)
+
+    return dispatch_cache().compiled(_forward_key(net), build)
 
 
-def extract_feature_matrix(col, in_shape, col_name: str = "features") -> np.ndarray:
-    """DataFrame Column -> (n, *in_shape) ndarray, shared by TPUModel and
+def extract_feature_matrix(col, in_shape, col_name: str = "features",
+                           prefer_device: bool = False) -> Any:
+    """DataFrame Column -> (n, *in_shape) array, shared by TPUModel and
     TPULearner so training and inference accept identical inputs.
 
     Keeps narrow dtypes (uint8 pixels) for the host->HBM transfer — 4x less
     traffic than float32; networks cast to their compute dtype on device
     (Network._cast_in). Only widens types jax can't ingest (object, 64-bit).
+
+    With `prefer_device=True`, a device-backed column stays on device: the
+    returned value is its jax.Array (dtype-widened / reshaped by on-device
+    ops), so the consuming stage dispatches with zero host round-trip.
     """
     from mmlspark_tpu.core.dataframe import DataType as DT
 
+    device = prefer_device and getattr(col, "is_device_backed", False)
     if col.dtype == DT.VECTOR:
-        x = col.values
+        x = col.device_values() if device else col.values
     elif col.dtype.is_numeric:
-        x = col.values.reshape(-1, 1)
+        x = (col.device_values() if device else col.values).reshape(-1, 1)
     else:
         raise TypeError(
             f"column {col_name!r} must be VECTOR or numeric, got "
             f"{col.dtype.value}; run UnrollImage / Featurize first"
         )
-    if x.dtype == object or x.dtype.kind not in "fiu":
+    kind, itemsize = np.dtype(x.dtype).kind, np.dtype(x.dtype).itemsize
+    if not device and (x.dtype == object or kind not in "fiu"):
         x = np.stack([np.asarray(v, dtype=np.float32) for v in x]) if x.dtype == object else x.astype(np.float32)
-    elif x.dtype.itemsize == 8:  # no f64/i64 on TPU
-        x = x.astype(np.float32 if x.dtype.kind == "f" else np.int32)
+    elif kind in "fiu" and itemsize == 8:  # no f64/i64 on TPU
+        # .astype is an on-device cast for jax.Arrays, a host cast for numpy
+        x = x.astype(np.float32 if kind == "f" else np.int32)
     in_shape = tuple(in_shape)
     flat_dim = int(np.prod(in_shape))
     if x.ndim == 2 and x.shape[1] == flat_dim and len(in_shape) > 1:
@@ -205,19 +220,29 @@ class TPUModel(Model, Wrappable):
             net = net.truncate_at(self.get(self.output_layer))
         return net
 
-    def _eval_batches(self, x: np.ndarray) -> np.ndarray:
+    def _eval_batches(self, x) -> Any:
+        """Minibatch eval. Host input -> device-resident result (jax.Array)
+        unless outputs spilled to host; device input (a device-backed
+        column) -> device result with ZERO host round-trips: chunking,
+        padding and trimming all run as compiled on-device programs.
+        """
         import jax
 
         bundle = self.get_model()
         bs = self.get(self.mini_batch_size)
-        fn = _compiled_forward(self._network_for_eval())
+        net = self._network_for_eval()
+        fn = _compiled_forward(net)
+        fkey = _forward_key(net)
+        cache = dispatch_cache()
+        counters = dataplane_counters()
+        device_in = is_device_array(x)
 
         if self.get(self.use_mesh):
             from mmlspark_tpu.parallel.mesh import data_parallel_mesh
 
             mesh = data_parallel_mesh()
-            n_data = mesh.shape["data"]
-            bs = max(bs, n_data) // n_data * n_data
+            mesh_div = mesh.shape["data"]
+            bs = max(bs, mesh_div) // mesh_div * mesh_div
             variables = jax.device_put(
                 bundle.variables, replicated_sharding(mesh)
             )
@@ -225,6 +250,7 @@ class TPUModel(Model, Wrappable):
         else:
             variables = bundle.device_variables()  # uploaded once per bundle
             in_shard = None
+            mesh_div = 1
 
         import jax.numpy as jnp
 
@@ -234,9 +260,10 @@ class TPUModel(Model, Wrappable):
         # SERIALIZED — issuing several async device_puts concurrently
         # collapses throughput ~50x, so each upload blocks before the next
         # dispatch; (b) D2H carries ~100 ms per-fetch latency, so results
-        # stay on device and are fetched ONCE at the end. Compute stays
-        # async behind the uploads; a window bounds in-flight batches so
-        # peak HBM stays O(window * batch), not O(dataset).
+        # stay on device and are fetched ONCE at the end (or never, when
+        # the consumer is another device stage). Compute stays async behind
+        # the uploads; a window bounds in-flight batches so peak HBM stays
+        # O(window * batch), not O(dataset).
         # Device-resident results are additionally capped: once accumulated
         # output elements pass _SPILL_ELEMS (f32 x 64M = 256 MB HBM) the
         # oldest batches spill to host, so peak HBM for results is bounded
@@ -248,13 +275,31 @@ class TPUModel(Model, Wrappable):
         spilled: list = []  # np arrays already fetched (large-output case)
         dev_elems = 0
         for start in range(0, n, bs):
-            chunk = x[start : start + bs]
-            padded, real = pad_to_multiple(chunk, bs, axis=0)
+            # slice_rows is a no-op for single-chunk inputs (every serving
+            # request) and a compiled static-bound slice for device input —
+            # an eager x[a:b] would promote its index scalars host->device,
+            # breaking the zero-transfer guarantee
+            chunk = slice_rows(x, start, start + bs)
+            # power-of-two row bucket: ragged (serving) batch sizes hit at
+            # most log2(bs)+1 compiled programs instead of one per size;
+            # under a mesh the bucket rounds up to the data-axis size so
+            # every chip keeps an equal slice (XLA requirement)
+            bucket = bucket_rows(int(chunk.shape[0]), cap=bs)
+            if mesh_div > 1:
+                bucket = -(-bucket // mesh_div) * mesh_div
+            padded, real = pad_rows(chunk, bucket)
             if in_shard is not None:
+                if not device_in:
+                    counters.record_h2d(getattr(padded, "nbytes", 0))
                 xd = jax.device_put(padded, in_shard)
+                xd.block_until_ready()
+            elif device_in:
+                xd = padded  # already resident; no upload, nothing to block on
             else:
+                counters.record_h2d(padded.nbytes)
                 xd = jax.device_put(padded)
-            xd.block_until_ready()
+                xd.block_until_ready()
+            cache.note_dispatch(fkey, (int(padded.shape[0]),) + tuple(x.shape[1:]))
             y = fn(variables, xd)
             in_flight.append(y)
             results.append((y, real))
@@ -263,7 +308,9 @@ class TPUModel(Model, Wrappable):
                 in_flight.pop(0).block_until_ready()
             while dev_elems > self._SPILL_ELEMS and len(results) > 1:
                 y0, real0 = results.pop(0)
-                spilled.append(np.asarray(y0[:real0], dtype=np.float32))
+                fetched = np.asarray(trim_rows(y0, real0), dtype=np.float32)
+                counters.record_d2h(fetched.nbytes)
+                spilled.append(fetched)
                 dev_elems -= int(np.prod(y0.shape))
                 # the fetch above synced y0 — keeping it in the window would
                 # defeat the HBM bound the spill exists to enforce
@@ -271,12 +318,17 @@ class TPUModel(Model, Wrappable):
         if not results and not spilled:
             out_dim = self._network_for_eval().out_shape()
             return np.zeros((0,) + tuple(out_dim), np.float32)
-        trimmed = [y[:real] for y, real in results]
+        trimmed = [trim_rows(y, real) for y, real in results]
         full = trimmed[0] if len(trimmed) == 1 else jnp.concatenate(trimmed, axis=0)
-        tail = np.asarray(full, dtype=np.float32)
+        if full.dtype != jnp.float32:  # bf16 compute -> f32 column (on device)
+            full = full.astype(jnp.float32)
         if spilled:
+            tail = np.asarray(full)
+            counters.record_d2h(tail.nbytes)
             return np.concatenate(spilled + [tail], axis=0)
-        return tail
+        # stay device-resident: the result column syncs to host lazily,
+        # only if a host-only consumer ever asks (core/dataframe.py)
+        return full
 
     # -- stage contract --------------------------------------------------------
 
@@ -289,9 +341,15 @@ class TPUModel(Model, Wrappable):
     def transform(self, df: DataFrame) -> DataFrame:
         in_col = self.get(self.input_col)
         net = self.get_model().network
-        x = extract_feature_matrix(df.column(in_col), net.input_shape, in_col)
+        # device-backed input columns stay on device end to end; host input
+        # uploads per (bucketed) minibatch as before
+        x = extract_feature_matrix(
+            df.column(in_col), net.input_shape, in_col, prefer_device=True
+        )
         y = self._eval_batches(x)
         if self.get(self.convert_output_to_dense_vector) and y.ndim > 2:
             y = y.reshape(y.shape[0], -1)
         out_dtype = DataType.VECTOR if y.ndim == 2 else None
+        # y may be a jax.Array: with_column then builds a device-backed
+        # column, so the next device-consuming stage reads HBM directly
         return df.with_column(self.get(self.output_col), y, out_dtype)

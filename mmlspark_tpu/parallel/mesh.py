@@ -66,10 +66,25 @@ def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0) -> Tuple[np.n
 def shard_batch(mesh, arr: np.ndarray):
     """Host array -> device array sharded along "data". Pads the batch to the
     data-axis size so every chip gets an equal slice (XLA requirement), and
-    returns (sharded_array, original_length)."""
+    returns (sharded_array, original_length). The upload is counted in
+    profiling.dataplane_counters()."""
     import jax
+
+    from mmlspark_tpu.utils.profiling import dataplane_counters
 
     n_data = mesh.shape[DATA_AXIS]
     padded, n = pad_to_multiple(np.asarray(arr), n_data, axis=0)
     sharding = batch_sharding(mesh, ndim=padded.ndim)
+    dataplane_counters().record_h2d(padded.nbytes)
     return jax.device_put(padded, sharding), n
+
+
+def shard_column(mesh, col):
+    """Device-stage a DataFrame Column along the mesh "data" axis without
+    going through host when it is already device-backed; host columns
+    upload once under the batch sharding. Returns the column's jax.Array.
+    The canonical way for mesh-wide stages to consume the columnar
+    dataplane (docs/dataplane.md)."""
+    if col.is_device_backed:
+        return col.device_values()
+    return col.device_values(batch_sharding(mesh, ndim=col.ndim))

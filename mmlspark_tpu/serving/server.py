@@ -337,7 +337,14 @@ class ServingServer:
     def stage_summary(self) -> Dict[str, float]:
         """p50/p99 decomposition of the recorded micro-batch stage timings
         (queue wait vs lock wait vs handler run) — the evidence base for
-        attributing tail latency (BASELINE.md serving section)."""
+        attributing tail latency (BASELINE.md serving section). Also carries
+        mean host<->device transfer counts per scored batch (the dataplane
+        hot-path metric: a device-resident handler pipeline should show
+        exactly one h2d for the request features and one d2h for the reply
+        sync — anything more is a stage boundary leaking through host).
+        The counters are process-wide, so per-batch attribution is exact
+        only while this server is the sole device user; under concurrent
+        engines treat these as an upper bound."""
         if not self.stage_timings:
             return {}
         out: Dict[str, float] = {}
@@ -348,6 +355,10 @@ class ServingServer:
         out["mean_batch_size"] = round(
             float(np.mean([t["batch_size"] for t in self.stage_timings])), 2
         )
+        for key in ("h2d_transfers", "d2h_transfers"):
+            per_batch = [t[key] for t in self.stage_timings if key in t]
+            if per_batch:
+                out[f"mean_{key}_per_batch"] = round(float(np.mean(per_batch)), 2)
         out["n_sampled"] = float(len(self.stage_timings))
         return out
 
@@ -376,12 +387,17 @@ class ServingServer:
                 batch = self._queue[: self.max_batch_size]
                 self._queue = self._queue[self.max_batch_size:]
             if batch:
+                from mmlspark_tpu.utils.profiling import dataplane_counters
+
+                counters = dataplane_counters()
                 ids = [rid for rid, _, _t in batch]
                 exchanges = [ex for _, ex, _t in batch]
                 t_assembled = time.monotonic()
                 with self._model_lock:
                     t_locked = time.monotonic()
+                    dp_before = counters.snapshot()
                     self._run_batch(ids, exchanges)
+                    dp = counters.delta(dp_before)
                 t_done = time.monotonic()
                 for _rid, _ex, t_enq in batch:
                     entry = {
@@ -389,6 +405,8 @@ class ServingServer:
                         "lock_wait_ms": (t_locked - t_assembled) * 1e3,
                         "handler_ms": (t_done - t_locked) * 1e3,
                         "batch_size": float(len(batch)),
+                        "h2d_transfers": float(dp["h2d_transfers"]),
+                        "d2h_transfers": float(dp["d2h_transfers"]),
                     }
                     # true ring: overwrite oldest so the summary tracks
                     # CURRENT traffic, not startup-era compiles

@@ -24,10 +24,22 @@ from mmlspark_tpu.core.pipeline import Transformer
 
 
 def _batch_column(col: Column, bounds: List[tuple]) -> Column:
+    """Batch one column. Numeric/VECTOR batches are ZERO-COPY views into the
+    source ndarray (no per-batch slice copies) and are marked read-only:
+    writing through a batch would silently corrupt the source column and
+    every sibling batch, so aliasing mistakes fail loudly instead. Object
+    batches (strings, structs) keep the list-of-values representation.
+    Device-backed columns batch from their (lazily synced) host values —
+    batched rows are object-dtype, a host-only representation."""
     out = np.empty(len(bounds), dtype=object)
+    values = col.values
     for i, (start, stop) in enumerate(bounds):
-        chunk = col.values[start:stop]
-        out[i] = list(chunk) if chunk.dtype == object else chunk
+        chunk = values[start:stop]
+        if chunk.dtype == object:
+            out[i] = list(chunk)
+        else:
+            chunk.flags.writeable = False
+            out[i] = chunk
     return Column(out, DataType.ARRAY, dict(col.metadata))
 
 

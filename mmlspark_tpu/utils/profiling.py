@@ -14,12 +14,72 @@ dispatch gaps, fusion, and HBM traffic on real hardware.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
-from typing import Iterator, Optional
+from typing import Dict, Iterator, Optional
 
 from mmlspark_tpu.core.config import get_logger
 
 log = get_logger("mmlspark_tpu.profiling")
+
+
+class DataplaneCounters:
+    """Process-wide host<->device transfer and compile counters.
+
+    The data plane (core/dataframe.py lazy column sync, core/dispatch.py
+    compiled-program cache, TPUModel/mesh device_puts) reports every
+    host->device upload, device->host fetch, and XLA program compile here,
+    so "zero host round-trips between device stages" is a measured metric
+    (bench.py --smoke, tests/test_dataplane.py) instead of a claim. Counts
+    are instrumentation-level: they track the framework's own transfer
+    points, not jax-internal scalar promotion.
+    """
+
+    _FIELDS = ("h2d_transfers", "h2d_bytes", "d2h_transfers", "d2h_bytes",
+               "compiles")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.h2d_transfers = 0
+            self.h2d_bytes = 0
+            self.d2h_transfers = 0
+            self.d2h_bytes = 0
+            self.compiles = 0
+
+    def record_h2d(self, nbytes: int = 0) -> None:
+        with self._lock:
+            self.h2d_transfers += 1
+            self.h2d_bytes += int(nbytes)
+
+    def record_d2h(self, nbytes: int = 0) -> None:
+        with self._lock:
+            self.d2h_transfers += 1
+            self.d2h_bytes += int(nbytes)
+
+    def record_compile(self) -> None:
+        with self._lock:
+            self.compiles += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {k: getattr(self, k) for k in self._FIELDS}
+
+    def delta(self, before: Dict[str, int]) -> Dict[str, int]:
+        """Counter movement since a previous snapshot()."""
+        now = self.snapshot()
+        return {k: now[k] - before.get(k, 0) for k in self._FIELDS}
+
+
+_DATAPLANE = DataplaneCounters()
+
+
+def dataplane_counters() -> DataplaneCounters:
+    """The process-wide dataplane counter singleton."""
+    return _DATAPLANE
 
 
 @contextlib.contextmanager

@@ -1,0 +1,22 @@
+"""Fixture for the host-sync-in-hot-path rule: forced device->host syncs
+inside a stage's `transform`. Parsed, never imported."""
+
+import numpy as np
+
+
+class BadDeviceStage:
+    def transform(self, df):
+        xd = df.column("features").device_values()
+        host = np.asarray(xd)  # expect[host-sync-in-hot-path]
+        scale = float(xd)  # expect[host-sync-in-hot-path]
+        xd.block_until_ready()  # expect[host-sync-in-hot-path]
+        alias = xd
+        again = np.asarray(alias)  # expect[host-sync-in-hot-path]
+        direct = np.asarray(df.column("f2").device_values())  # expect[host-sync-in-hot-path]
+        fine = np.asarray(df.column("labels").values)  # host-backed access: clean
+        justified = np.asarray(xd)  # graftcheck: ignore[host-sync-in-hot-path]  # expect-suppressed[host-sync-in-hot-path]
+        return host, scale, again, direct, fine, justified
+
+    def fit(self, df):
+        # outside transform: syncing during fit is legitimate (not flagged)
+        return np.asarray(df.column("features").device_values())

@@ -1,0 +1,45 @@
+"""Tier-1 gate for the dataplane smoke bench (ISSUE 3 acceptance): runs
+bench.run_smoke on the CPU backend, emits BENCH_pr03.json at the repo root,
+and asserts the device-resident dataplane beats the pre-change dataflow on
+the meters that define it — stage-boundary transfers for the fused
+TPUModel chain, upload bytes + bounded compiles for serving-style ragged
+batches."""
+
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "BENCH_pr03.json")
+
+
+def test_smoke_bench_beats_pre_change_baseline():
+    import bench
+
+    report = bench.run_smoke(OUT)
+
+    chain = report["tpu_model_chain"]
+    resident, baseline = chain["resident"], chain["baseline_host_roundtrip"]
+    # fused chain: strictly fewer transfers in BOTH directions than the
+    # host-round-trip dataflow (1 entry upload + 1 exit fetch vs 2 + 2)
+    assert resident["h2d_transfers"] < baseline["h2d_transfers"], chain
+    assert resident["d2h_transfers"] < baseline["d2h_transfers"], chain
+    assert resident["h2d_bytes"] < baseline["h2d_bytes"], chain
+
+    serving = report["serving_ragged"]
+    bucketed = serving["bucketed_resident"]
+    fixed = serving["baseline_fixed_pad_roundtrip"]
+    assert serving["distinct_sizes"] == 50
+    # at most log2(128)+1 programs per stage for 50 ragged sizes
+    assert 0 < serving["max_programs_per_stage"] <= 8, serving
+    # strictly fewer transfers AND bytes than the pre-change serving flow
+    assert bucketed["h2d_transfers"] < fixed["h2d_transfers"], serving
+    assert bucketed["d2h_transfers"] < fixed["d2h_transfers"], serving
+    assert bucketed["h2d_bytes"] < fixed["h2d_bytes"], serving
+
+    # the artifact the driver reads
+    with open(OUT) as f:
+        on_disk = json.load(f)
+    assert (
+        on_disk["serving_ragged"]["bucketed_resident"]["compiles"]
+        == bucketed["compiles"]
+    )

@@ -1,0 +1,281 @@
+"""Device-resident dataplane tests (ISSUE 3 tentpole).
+
+The headline guarantees, each verified with jax.transfer_guard and/or the
+dataplane counters rather than vibes:
+
+- a fused featurize -> TPUModel -> select chain performs ZERO host<->device
+  transfers between device-consuming stages;
+- 50 ragged serving batch sizes compile at most log2(max_batch)+1 = 8
+  programs through the shared shape-bucketed dispatch cache;
+- select/rename/with_metadata/slice/limit are zero-copy views that preserve
+  device residency;
+- metadata dicts deep-copy at derivation boundaries (mutate-after-derive
+  cannot corrupt sibling frames);
+- MiniBatch numeric batches are zero-copy views with loud aliasing safety.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType
+from mmlspark_tpu.core.dispatch import bucket_rows, dispatch_cache
+from mmlspark_tpu.core.pipeline import PipelineModel
+from mmlspark_tpu.dnn import mlp
+from mmlspark_tpu.dnn.network import NetworkBundle
+from mmlspark_tpu.models import TPUModel
+from mmlspark_tpu.utils.profiling import dataplane_counters
+
+
+def _tpu_model(in_dim, hidden, out_dim, in_col, out_col, bs=8, seed=0):
+    net = mlp(in_dim, [hidden], out_dim)
+    bundle = NetworkBundle(net, net.init(jax.random.PRNGKey(seed)))
+    return TPUModel(bundle, input_col=in_col, output_col=out_col,
+                    mini_batch_size=bs)
+
+
+# -- device-backed columns -----------------------------------------------------
+
+
+def test_device_backed_column_lazy_sync_counted():
+    counters = dataplane_counters()
+    xd = jax.device_put(np.arange(12, dtype=np.float32).reshape(4, 3))
+    col = Column(xd)
+    assert col.is_device_backed
+    assert col.dtype == DataType.VECTOR
+    assert len(col) == 4 and col.shape == (4, 3)  # no sync needed
+
+    before = counters.snapshot()
+    host = col.values  # first host access syncs...
+    d = counters.delta(before)
+    assert d["d2h_transfers"] == 1 and d["d2h_bytes"] == host.nbytes
+    before = counters.snapshot()
+    _ = col.values  # ...then it's cached
+    assert counters.delta(before)["d2h_transfers"] == 0
+    np.testing.assert_array_equal(host, np.arange(12).reshape(4, 3))
+
+
+def test_host_column_uploads_once():
+    counters = dataplane_counters()
+    col = Column(np.ones((5, 2), np.float32))
+    assert not col.is_device_backed
+    before = counters.snapshot()
+    dv = col.device_values()
+    assert counters.delta(before)["h2d_transfers"] == 1
+    before = counters.snapshot()
+    assert col.device_values() is dv  # cached
+    assert counters.delta(before)["h2d_transfers"] == 0
+
+
+def test_object_column_refuses_device():
+    col = Column(np.array(["a", "b"], object), DataType.STRING)
+    with pytest.raises(TypeError, match="host-only"):
+        col.device_values()
+
+
+def test_views_preserve_device_residency_without_sync():
+    counters = dataplane_counters()
+    xd = jax.device_put(np.ones((6, 2), np.float32))
+    df = DataFrame({"f": Column(xd), "s": Column(np.array(list("abcdef"), object), DataType.STRING)})
+    before = counters.snapshot()
+    out = (
+        df.select("f")
+        .rename("f", "g")
+        .with_metadata("g", {"note": "x"})
+        .limit(4)
+    )
+    assert counters.delta(before)["d2h_transfers"] == 0
+    assert out.column("g").is_device_backed
+    assert len(out) == 4
+    assert out.column("g").metadata == {"note": "x"}
+
+
+def test_view_aliases_share_one_sync():
+    """rename/select aliases share the storage cell: the exit fetch happens
+    once no matter which alias a host consumer reads."""
+    counters = dataplane_counters()
+    df = DataFrame({"a": Column(jax.device_put(np.ones((100, 8), np.float32)))})
+    renamed = df.rename("a", "b")
+    before = counters.snapshot()
+    _ = renamed["b"]
+    _ = df["a"]  # alias: must serve the cached host copy
+    d = counters.delta(before)
+    assert d["d2h_transfers"] == 1 and d["d2h_bytes"] == 100 * 8 * 4, d
+
+
+def test_device_sync_honors_declared_double_dtype():
+    """A device f32 column declared DOUBLE widens to float64 on host sync,
+    keeping transform_schema's dtype contract (gbdt prediction columns)."""
+    col = Column(jax.device_put(np.ones(5, np.float32)), DataType.DOUBLE)
+    assert col.values.dtype == np.float64
+
+    from mmlspark_tpu.gbdt import LightGBMRegressor
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(60, 3))
+    train = DataFrame.from_dict({"features": x, "label": x[:, 0] * 2.0})
+    model = LightGBMRegressor(num_iterations=4, num_leaves=4, verbosity=0).fit(train)
+    out = model.transform(DataFrame.from_dict(
+        {"features": x.astype(np.float32)}).to_device("features"))
+    assert out.column("prediction").is_device_backed
+    assert out["prediction"].dtype == np.float64
+
+
+def test_multi_chunk_device_input_stays_transfer_free():
+    """Device inputs larger than mini_batch_size chunk through compiled
+    slices — still zero transfers under the guard."""
+    counters = dataplane_counters()
+    model = _tpu_model(4, 8, 3, "f", "o", bs=8, seed=9)
+    xd = jax.device_put(
+        np.random.default_rng(8).normal(size=(20, 4)).astype(np.float32)
+    )
+    df = DataFrame({"f": Column(xd)})
+    expected = np.asarray(model.transform(df)["o"])  # warm all chunk shapes
+    before = counters.snapshot()
+    with jax.transfer_guard("disallow"):
+        out = model.transform(df)
+    d = counters.delta(before)
+    assert d["h2d_transfers"] == 0 and d["d2h_transfers"] == 0, d
+    np.testing.assert_allclose(np.asarray(out["o"]), expected, rtol=1e-5)
+
+
+def test_host_slice_is_zero_copy_view():
+    col = Column(np.arange(10, dtype=np.float64))
+    sl = col.slice(2, 7)
+    assert np.shares_memory(sl.values, col.values)
+    df = DataFrame({"a": col})
+    assert np.shares_memory(df.limit(3)["a"], df["a"])
+
+
+# -- metadata aliasing (satellite regression) ----------------------------------
+
+
+def test_metadata_deepcopy_at_derivation_boundaries():
+    meta = {"categorical": {"levels": ["a", "b"], "ordinal": False}}
+    df = DataFrame.from_dict({"c": [1.0, 2.0]}, metadata={"c": meta})
+
+    derived_with = df.with_column("d", df.column("c"))
+    derived_with.column("d").metadata["categorical"]["levels"].append("EVIL")
+    assert df.column("c").metadata["categorical"]["levels"] == ["a", "b"]
+
+    derived_ren = df.rename("c", "cc")
+    derived_ren.column("cc").metadata["categorical"]["levels"].append("EVIL")
+    assert df.column("c").metadata["categorical"]["levels"] == ["a", "b"]
+
+    sliced = df.column("c").slice(0, 1)
+    sliced.metadata["categorical"]["levels"].append("EVIL")
+    assert df.column("c").metadata["categorical"]["levels"] == ["a", "b"]
+
+    wm = df.with_metadata("c", {"categorical": {"levels": ["z"]}})
+    wm.column("c").metadata["categorical"]["levels"].append("EVIL")
+    assert df.column("c").metadata["categorical"]["levels"] == ["a", "b"]
+
+
+# -- minibatch zero-copy views (satellite) -------------------------------------
+
+
+def test_batch_column_numeric_views_and_aliasing_safety():
+    from mmlspark_tpu.stages import FixedMiniBatchTransformer, FlattenBatch
+
+    base = np.arange(10, dtype=np.float64)
+    df = DataFrame.from_dict({"x": base, "s": np.array(list("abcdefghij"), object)})
+    batched = FixedMiniBatchTransformer(4).transform(df)
+    b0 = batched["x"][0]
+    assert np.shares_memory(b0, df["x"])  # zero-copy view
+    with pytest.raises((ValueError, RuntimeError)):
+        b0[0] = 999.0  # aliasing safety: writes fail loudly
+    assert df["x"][0] == 0.0  # source untouched
+    flat = FlattenBatch().transform(batched)
+    np.testing.assert_array_equal(flat["x"], base)
+    assert list(flat["s"]) == list("abcdefghij")
+
+
+# -- the tentpole guarantees ---------------------------------------------------
+
+
+def test_fused_pipeline_zero_transfers_between_device_stages():
+    """featurize -> TPUModel -> select with jax.transfer_guard("disallow"):
+    the interior stage boundary moves zero bytes over the host<->HBM link.
+    Belt and braces: the guard catches implicit transfers, the dataplane
+    counters catch explicit ones."""
+    counters = dataplane_counters()
+    featurize = _tpu_model(4, 9, 6, "features", "embedding", seed=0)
+    head = _tpu_model(6, 9, 3, "embedding", "scores", seed=1)
+    df = DataFrame.from_dict(
+        {"features": np.random.default_rng(0).normal(size=(6, 4)).astype(np.float32)}
+    )
+
+    pipeline = PipelineModel([featurize, head])
+    warm = pipeline.transform(df)  # compiles + weight uploads
+    expected = np.asarray(warm["scores"])
+
+    # per-stage accounting: the interior boundary is transfer-free
+    pipeline.transform(df)
+    (_, feat_delta), (_, head_delta) = pipeline.last_stage_dataplane
+    assert feat_delta["h2d_transfers"] == 1  # the one pipeline-entry upload
+    assert feat_delta["d2h_transfers"] == 0
+    assert head_delta["h2d_transfers"] == 0 and head_delta["d2h_transfers"] == 0
+
+    # the hard guarantee, under the guard
+    mid = featurize.transform(df)
+    assert mid.column("embedding").is_device_backed
+    before = counters.snapshot()
+    with jax.transfer_guard("disallow"):
+        out = head.transform(mid).select("scores")
+    delta = counters.delta(before)
+    assert delta["h2d_transfers"] == 0 and delta["d2h_transfers"] == 0, delta
+    assert out.column("scores").is_device_backed
+    np.testing.assert_allclose(np.asarray(out["scores"]), expected, rtol=1e-5)
+
+
+def test_ragged_serving_batches_bounded_compiles():
+    """50 distinct batch sizes in [1, 128] through one TPUModel compile at
+    most log2(128)+1 = 8 programs (power-of-two bucketing in the shared
+    dispatch cache) — not one per size."""
+    dispatch_cache().clear()
+    counters = dataplane_counters()
+    model = _tpu_model(5, 7, 2, "features", "scores", bs=128, seed=2)
+    sizes = np.random.default_rng(3).permutation(np.arange(1, 129))[:50]
+    assert len(set(sizes.tolist())) == 50
+    before = counters.snapshot()
+    for n in sizes:
+        out = model.transform(
+            DataFrame.from_dict({"features": np.ones((int(n), 5), np.float32)})
+        )
+        assert np.asarray(out["scores"]).shape == (int(n), 2)
+    compiles = counters.delta(before)["compiles"]
+    assert 0 < compiles <= 8, compiles
+    expected_buckets = {bucket_rows(int(n), cap=128) for n in sizes}
+    assert compiles == len(expected_buckets)
+
+
+def test_gbdt_scoring_accepts_and_produces_device_columns():
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(80, 5))
+    y = (x[:, 0] + 0.3 * x[:, 1] > 0).astype(np.float64)
+    train = DataFrame.from_dict({"features": x, "label": y})
+    model = LightGBMClassifier(num_iterations=5, num_leaves=4, verbosity=0).fit(train)
+
+    test = DataFrame.from_dict({"features": x[:20].astype(np.float32)})
+    host_out = model.transform(test)
+    dev_out = model.transform(test.to_device("features"))
+    for col in ("rawPrediction", "probability", "prediction"):
+        assert dev_out.column(col).is_device_backed, col
+    np.testing.assert_allclose(
+        dev_out["probability"], host_out["probability"], rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_array_equal(dev_out["prediction"], host_out["prediction"])
+
+
+def test_tpu_model_host_path_results_unchanged():
+    """Device residency must not change what host consumers see."""
+    model = _tpu_model(4, 8, 3, "features", "scores", bs=4, seed=5)
+    x = np.random.default_rng(6).normal(size=(10, 4)).astype(np.float32)
+    out = model.transform(DataFrame.from_dict({"features": x}))
+    net = model.get_model().network
+    expected = np.asarray(net.apply(model.get_model().variables, x))
+    np.testing.assert_allclose(out["scores"], expected, rtol=1e-5, atol=1e-6)
+    assert out["scores"].dtype == np.float32

@@ -117,6 +117,30 @@ def test_broad_except_fires_and_suppresses():
     _assert_matches_markers("hygiene_bad.py", findings)
 
 
+# -- hot path -----------------------------------------------------------------
+
+
+def test_host_sync_in_hot_path_fires_and_suppresses():
+    from mmlspark_tpu.analysis.hot_path import check_hot_path
+
+    path = os.path.join(FIXTURES, "hot_path_bad.py")
+    findings = check_hot_path([path], repo_root=FIXTURES)
+    _assert_matches_markers("hot_path_bad.py", findings)
+
+
+def test_host_sync_rule_ignores_non_transform_functions():
+    from mmlspark_tpu.analysis.hot_path import check_hot_path
+
+    path = os.path.join(FIXTURES, "hot_path_bad.py")
+    findings = check_hot_path([path], repo_root=FIXTURES)
+    # the fit() sync in the fixture must NOT be flagged
+    with open(path) as f:
+        fit_line = next(
+            i for i, line in enumerate(f, start=1) if "def fit" in line
+        )
+    assert all(f.line < fit_line for f in findings)
+
+
 # -- schema flow --------------------------------------------------------------
 
 
