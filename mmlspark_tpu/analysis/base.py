@@ -26,6 +26,8 @@ RULES: Dict[str, str] = {
     "broad-except": "bare except/except Exception that neither re-raises nor records the error",
     # hot-path family (hot_path.py)
     "host-sync-in-hot-path": "np.asarray/float()/block_until_ready on device-backed column values inside transform",
+    # lock-scope family (lock_scope.py)
+    "blocking-host-work-under-lock": "json.loads/json.dumps/parse_request/make_reply inside a model-lock critical section starves device dispatch",
     # Params-contract family (params_contract.py)
     "param-converter": "simple Param declared without an explicit type converter",
     "param-doc": "stage or Param missing documentation",

@@ -4,6 +4,7 @@ Recognized keys (all optional):
 
     disable = ["rule-id", ...]     # rules to skip entirely
     exclude = ["path/prefix", ...] # repo-relative path prefixes to skip
+    lock_names = ["_model_lock"]   # blocking-host-work-under-lock lock names
 
 Parsed with tomllib/tomli when available; otherwise a minimal line parser
 that understands exactly the shape above (string lists under one table) so
@@ -22,6 +23,9 @@ from typing import List, Optional
 class GraftcheckConfig:
     disable: List[str] = field(default_factory=list)
     exclude: List[str] = field(default_factory=list)
+    # lock attribute/variable names treated as model-lock critical sections
+    # by the blocking-host-work-under-lock rule
+    lock_names: List[str] = field(default_factory=lambda: ["_model_lock"])
     root: str = "."
 
     def path_excluded(self, rel_path: str) -> bool:
@@ -114,4 +118,7 @@ def load_config(root: Optional[str] = None) -> GraftcheckConfig:
     table = data.get("tool", {}).get("graftcheck", {})
     cfg.disable = [str(x) for x in table.get("disable", [])]
     cfg.exclude = [str(x) for x in table.get("exclude", [])]
+    lock_names = table.get("lock_names", table.get("lock-names"))
+    if lock_names:
+        cfg.lock_names = [str(x) for x in lock_names]
     return cfg

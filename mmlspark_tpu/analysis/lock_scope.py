@@ -1,0 +1,105 @@
+"""Lock-scope rule: no blocking host work inside a model-lock critical
+section.
+
+`blocking-host-work-under-lock` flags, inside any ``with`` block whose
+context expression is (an attribute ending in) one of the configured lock
+names (default: ``_model_lock``; ``[tool.graftcheck] lock_names`` overrides):
+
+- ``json.loads(...)`` / ``json.dumps(...)`` — request decode / reply encode
+  happening while the device dispatch queue is starved behind the lock;
+- any call to ``parse_request`` / ``make_reply`` (bare name or method) —
+  the serving sugar that wraps exactly that JSON work plus host<->device
+  transfers.
+
+This is the anti-pattern the pipelined serving engine exists to remove
+(docs/serving.md): every millisecond of JSON under the model lock is a
+millisecond the score stage cannot feed the accelerator. Host work belongs
+in the parse/reply stages, outside the lock. A justified exception (e.g. a
+tiny control-plane payload) takes
+``# graftcheck: ignore[blocking-host-work-under-lock]``.
+
+Detection is lexical (the ``with`` body's AST subtree), matching the rule's
+intent: reviewers can see the lock and the call in the same screenful.
+Calls behind another function boundary are the jit-safety family's
+interprocedural territory, not this rule's.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Sequence
+
+from mmlspark_tpu.analysis.base import Finding
+
+_RULE = "blocking-host-work-under-lock"
+_DEFAULT_LOCK_NAMES = ("_model_lock",)
+_JSON_FUNCS = {"loads", "dumps"}
+_SERVING_FUNCS = {"parse_request", "make_reply"}
+
+
+def _lock_name(expr: ast.AST) -> Optional[str]:
+    """The trailing identifier of a with-item context expression:
+    `self._model_lock` -> "_model_lock", `_model_lock` -> "_model_lock",
+    `lock.acquire_timeout(...)`-style calls are not lock contexts here."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _blocked_call(node: ast.Call) -> Optional[str]:
+    func = node.func
+    if (
+        isinstance(func, ast.Attribute)
+        and func.attr in _JSON_FUNCS
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "json"
+    ):
+        return f"json.{func.attr}"
+    if isinstance(func, ast.Name) and func.id in _SERVING_FUNCS:
+        return func.id
+    if isinstance(func, ast.Attribute) and func.attr in _SERVING_FUNCS:
+        return func.attr
+    return None
+
+
+def _scan_with(node: ast.With, rel: str, lock_names: Sequence[str],
+               findings: List[Finding]) -> None:
+    if not any(_lock_name(item.context_expr) in lock_names for item in node.items):
+        return
+    for stmt in node.body:
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            what = _blocked_call(sub)
+            if what is not None:
+                findings.append(Finding(
+                    _RULE, rel, sub.lineno,
+                    f"{what}() inside a model-lock critical section blocks "
+                    "device dispatch on host JSON work; move it to the "
+                    "parse/reply stage outside the lock",
+                ))
+
+
+def check_lock_scope(
+    paths: Iterable[str],
+    repo_root: Optional[str] = None,
+    lock_names: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    repo_root = repo_root or os.getcwd()
+    lock_names = tuple(lock_names) if lock_names else _DEFAULT_LOCK_NAMES
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, repo_root)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.With):
+                _scan_with(node, rel, lock_names, findings)
+    return findings

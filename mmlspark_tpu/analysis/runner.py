@@ -86,6 +86,12 @@ def run_all(
         from mmlspark_tpu.analysis.hot_path import check_hot_path
 
         findings += check_hot_path(package_files, repo_root=root)
+    if "blocking-host-work-under-lock" in enabled:
+        from mmlspark_tpu.analysis.lock_scope import check_lock_scope
+
+        findings += check_lock_scope(
+            package_files, repo_root=root, lock_names=cfg.lock_names
+        )
     if enabled & _PARAM_RULES:
         from mmlspark_tpu.analysis.params_contract import check_params_contract
 

@@ -21,6 +21,16 @@ device-consuming stage shares:
 `bucketing(False)` restores the pre-bucketing behavior (pad every batch to
 the full cap) — the rollback lever and the baseline bench.py --smoke
 measures against.
+
+- **Donation**: callers that OWN an input buffer (a freshly uploaded or
+  freshly padded batch nobody will read again) may dispatch through a
+  donating program (``jax.jit(..., donate_argnums=...)``): XLA releases —
+  and where shapes/dtypes line up, reuses — the input's HBM at dispatch
+  instead of holding it until Python GC. Under steady serving traffic this
+  is the difference between bounded HBM churn and per-request buffer
+  accumulation. ``donation(False)`` is the scoped rollback lever, mirroring
+  ``bucketing(False)``; donating and non-donating variants are distinct
+  compiled programs, so they must use distinct cache/accounting keys.
 """
 
 from __future__ import annotations
@@ -47,6 +57,27 @@ def bucketing(enabled: bool) -> Iterator[None]:
         yield
     finally:
         _BUCKETING_ENABLED = prev
+
+
+_DONATION_ENABLED = True
+
+
+@contextlib.contextmanager
+def donation(enabled: bool) -> Iterator[None]:
+    """Scoped toggle for donation-backed dispatch (True is the default;
+    False keeps every program non-donating — the rollback lever)."""
+    global _DONATION_ENABLED
+    prev = _DONATION_ENABLED
+    _DONATION_ENABLED = enabled
+    try:
+        yield
+    finally:
+        _DONATION_ENABLED = prev
+
+
+def donation_enabled() -> bool:
+    """Whether donation-backed dispatch is currently enabled."""
+    return _DONATION_ENABLED
 
 
 def bucket_rows(n: int, cap: Optional[int] = None) -> int:

@@ -28,6 +28,7 @@ from mmlspark_tpu.core.dataframe import DataFrame, DataType, Field, is_device_ar
 from mmlspark_tpu.core.dispatch import (
     bucket_rows,
     dispatch_cache,
+    donation_enabled,
     pad_rows,
     slice_rows,
     trim_rows,
@@ -39,14 +40,23 @@ from mmlspark_tpu.parallel.mesh import batch_sharding, replicated_sharding
 from mmlspark_tpu.utils.profiling import dataplane_counters
 
 
-def _forward_key(net: Network):
-    return ("tpu_model.forward", str(net.spec), str(net.input_shape), net.compute_dtype)
+def _forward_key(net: Network, donate: bool = False):
+    key = ("tpu_model.forward", str(net.spec), str(net.input_shape), net.compute_dtype)
+    return key + ("donate",) if donate else key
 
 
-def _compiled_forward(net: Network):
+def _compiled_forward(net: Network, donate: bool = False):
     """Shared compiled forward, keyed by (spec, input_shape, dtype) in the
     process-wide core.dispatch cache so every TPUModel instance wrapping the
-    same network shares one jit wrapper (and its per-bucket programs)."""
+    same network shares one jit wrapper (and its per-bucket programs).
+
+    `donate=True` builds the donation-backed variant (`donate_argnums` on the
+    batch arg): XLA releases the input buffer's HBM at dispatch instead of
+    holding it until GC. Callers must OWN the buffer — a freshly uploaded or
+    freshly padded batch no column storage aliases — because the donated
+    array is deleted. Donating and plain variants are distinct programs, so
+    they live under distinct cache keys and compile-accounting keys.
+    """
 
     def build():
         import jax
@@ -54,9 +64,27 @@ def _compiled_forward(net: Network):
         def fwd(variables, x):
             return net.apply(variables, x)
 
+        if donate:
+            # donation reuses the input's buffer only when an output's
+            # shape/dtype matches (XLA input-output aliasing); when they
+            # don't, jax warns once per program that the donation "was not
+            # usable" — expected and benign here (the buffer is still
+            # released at its last use rather than held until GC), and not
+            # worth suppressing process-wide
+            return jax.jit(fwd, donate_argnums=(1,))
         return jax.jit(fwd)
 
-    return dispatch_cache().compiled(_forward_key(net), build)
+    return dispatch_cache().compiled(_forward_key(net, donate), build)
+
+
+def forward_program_count(net: Network) -> int:
+    """Distinct compiled (program, shape) pairs for `net`'s forward across
+    BOTH dispatch variants — the honest per-stage program count now that
+    donation splits the forward into two cache keys (bench.py --smoke)."""
+    cache = dispatch_cache()
+    return cache.distinct_programs(_forward_key(net)) + cache.distinct_programs(
+        _forward_key(net, donate=True)
+    )
 
 
 def extract_feature_matrix(col, in_shape, col_name: str = "features",
@@ -232,7 +260,18 @@ class TPUModel(Model, Wrappable):
         bs = self.get(self.mini_batch_size)
         net = self._network_for_eval()
         fn = _compiled_forward(net)
+        # donation-backed dispatch (core/dispatch.py): when we OWN the batch
+        # buffer, the donating program releases its HBM at dispatch instead
+        # of holding it until GC — bounded churn under serving traffic. Mesh
+        # dispatch keeps the plain program (sharded inputs are resharded
+        # device_puts whose lifetime the mesh runtime manages).
+        fn_donate = (
+            _compiled_forward(net, donate=True)
+            if donation_enabled() and not self.get(self.use_mesh)
+            else None
+        )
         fkey = _forward_key(net)
+        fkey_donate = _forward_key(net, donate=True)
         cache = dispatch_cache()
         counters = dataplane_counters()
         device_in = is_device_array(x)
@@ -299,8 +338,16 @@ class TPUModel(Model, Wrappable):
                 counters.record_h2d(padded.nbytes)
                 xd = jax.device_put(padded)
                 xd.block_until_ready()
-            cache.note_dispatch(fkey, (int(padded.shape[0]),) + tuple(x.shape[1:]))
-            y = fn(variables, xd)
+            # We own xd when it was freshly uploaded (host input) or freshly
+            # produced by a compiled slice/pad (`padded is not x`); donating
+            # the input column's own storage would delete it under the
+            # caller's feet, so those dispatches stay non-donating.
+            donate = fn_donate is not None and (not device_in or padded is not x)
+            cache.note_dispatch(
+                fkey_donate if donate else fkey,
+                (int(padded.shape[0]),) + tuple(x.shape[1:]),
+            )
+            y = (fn_donate if donate else fn)(variables, xd)
             in_flight.append(y)
             results.append((y, real))
             dev_elems += int(np.prod(y.shape))

@@ -88,3 +88,37 @@ def shard_column(mesh, col):
     if col.is_device_backed:
         return col.device_values()
     return col.device_values(batch_sharding(mesh, ndim=col.ndim))
+
+
+def shard_frame(mesh, df, columns: Optional[Sequence[str]] = None):
+    """Upload `df`'s device-eligible columns sharded along the mesh "data"
+    axis, returning a frame whose columns are device-backed. This is how the
+    serving engine's parse stage feeds a multi-device handler: uploads are
+    sharded at the pipeline entry (outside the score stage's critical
+    section), so user handlers consume mesh-distributed batches without any
+    code changes. Non-numeric (object-dtype) columns pass through host-side.
+
+    Ragged serving batch sizes rarely divide the data axis, so host columns
+    go through shard_batch (pad to a data-axis multiple, XLA's divisibility
+    requirement) and are trimmed back on device — the trim is a compiled
+    static-bound slice, so no row count ever round-trips through host."""
+    from mmlspark_tpu.core.dataframe import DataType
+    from mmlspark_tpu.core.dispatch import trim_rows
+
+    out = df
+    for name in (columns if columns is not None else df.columns):
+        col = df.column(name)
+        if col.dtype is None or not (
+            col.dtype == DataType.VECTOR or col.dtype.is_numeric
+        ):
+            continue
+        if col.is_device_backed:
+            out = out.with_column(name, col.device_values(), col.dtype)
+            continue
+        if col.values.dtype == object:
+            continue  # ragged vectors stay host-side
+        sharded, n = shard_batch(mesh, col.values)
+        if int(sharded.shape[0]) != n:
+            sharded = trim_rows(sharded, n)
+        out = out.with_column(name, sharded, col.dtype)
+    return out

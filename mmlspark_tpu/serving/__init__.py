@@ -17,7 +17,11 @@ for minimum latency. No offsets, no epochs, no port forwarding.
 """
 
 from mmlspark_tpu.serving.server import (
+    MALFORMED_COL,
+    PipelineServingHandler,
     ServingServer,
+    StagedServingHandler,
+    as_staged_handler,
     make_reply,
     parse_request,
     serve_pipeline,
@@ -26,7 +30,11 @@ from mmlspark_tpu.serving.distributed import DistributedServingServer
 
 __all__ = [
     "DistributedServingServer",
+    "MALFORMED_COL",
+    "PipelineServingHandler",
     "ServingServer",
+    "StagedServingHandler",
+    "as_staged_handler",
     "make_reply",
     "parse_request",
     "serve_pipeline",

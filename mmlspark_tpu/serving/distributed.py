@@ -52,6 +52,8 @@ class DistributedServingServer:
         max_batch_size: int = 64,
         max_wait_ms: float = 5.0,
         request_timeout: float = 30.0,
+        engine: str = "pipelined",
+        in_flight_depth: int = 2,
     ):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
@@ -68,6 +70,8 @@ class DistributedServingServer:
                 max_batch_size=max_batch_size,
                 max_wait_ms=max_wait_ms,
                 request_timeout=request_timeout,
+                engine=engine,
+                in_flight_depth=in_flight_depth,
             )
             for _ in range(n_workers)
         ]

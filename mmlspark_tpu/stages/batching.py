@@ -50,6 +50,38 @@ def _batch_df(df: DataFrame, bounds: List[tuple]) -> DataFrame:
     )
 
 
+class AdaptiveBatchPolicy:
+    """Deadline-aware coalescing decision for the serving engine's dispatch
+    stage (serving/server.py): score IMMEDIATELY when nothing is in flight
+    (an idle device earns nothing by waiting — the Clipper/Orca shape), and
+    stretch toward max_wait_ms / max_batch_size only while earlier batches
+    are still feeding the score stage (dispatched but not yet scored), so
+    waiting buys batch efficiency instead of latency. Pure policy object —
+    no clocks, no locks — so the dispatch loop's behavior is unit-testable
+    without a server."""
+
+    def __init__(self, max_batch_size: int, max_wait_ms: float):
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait_ms = float(max_wait_ms)
+
+    def should_dispatch(self, queued: int, oldest_wait_ms: float, in_flight: int) -> bool:
+        """True when the queued requests should be scored NOW."""
+        if queued <= 0:
+            return False
+        if queued >= self.max_batch_size:
+            return True
+        if in_flight <= 0:
+            return True  # device idle: batching would trade latency for nothing
+        return oldest_wait_ms >= self.max_wait_ms
+
+    def wait_budget_s(self, oldest_wait_ms: float) -> float:
+        """How long the dispatch loop may sleep before the oldest queued
+        request's coalescing deadline lapses."""
+        return max(0.0, (self.max_wait_ms - oldest_wait_ms) / 1e3)
+
+
 class FixedMiniBatchTransformer(Transformer, Wrappable):
     """Group rows into fixed-size batches (reference default for CNTKModel:
     FixedMiniBatchTransformer(10), CNTKModel.scala:376)."""

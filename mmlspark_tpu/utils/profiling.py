@@ -82,6 +82,88 @@ def dataplane_counters() -> DataplaneCounters:
     return _DATAPLANE
 
 
+class ServingPipelineCounters:
+    """Occupancy and backpressure meters for the pipelined serving engine
+    (serving/server.py): per-stage busy time (parse | score | reply),
+    in-flight batch depth (current + peak), adaptive-coalescing dispatch
+    decisions, and replies dropped because the client's deadline passed
+    while the batch was in flight.
+
+    One instance per engine (NOT process-wide like DataplaneCounters): a
+    server's occupancy is meaningful only against its own wall clock.
+    `summary()` is the evidence base for "the device never waits on JSON
+    work" — score occupancy near the wall fraction the model genuinely
+    needs, with parse/reply busy time overlapped rather than serialized.
+    """
+
+    STAGES = ("parse", "score", "reply")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._t0 = time.monotonic()
+            self.stage_busy_s = {s: 0.0 for s in self.STAGES}
+            self.stage_batches = {s: 0 for s in self.STAGES}
+            self.rows = 0
+            self.expired_in_flight = 0
+            self.in_flight = 0
+            self.in_flight_peak = 0
+            self.immediate_dispatches = 0
+            self.coalesced_dispatches = 0
+
+    @contextlib.contextmanager
+    def stage(self, name: str, rows: int = 0) -> Iterator[None]:
+        """Time one batch through one stage; `rows` accrues only via the
+        parse stage so the total isn't triple-counted."""
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            dt = time.monotonic() - t0
+            with self._lock:
+                self.stage_busy_s[name] += dt
+                self.stage_batches[name] += 1
+                self.rows += rows
+
+    def enter_in_flight(self) -> None:
+        with self._lock:
+            self.in_flight += 1
+            self.in_flight_peak = max(self.in_flight_peak, self.in_flight)
+
+    def exit_in_flight(self) -> None:
+        with self._lock:
+            self.in_flight = max(0, self.in_flight - 1)
+
+    def record_dispatch(self, immediate: bool) -> None:
+        with self._lock:
+            if immediate:
+                self.immediate_dispatches += 1
+            else:
+                self.coalesced_dispatches += 1
+
+    def record_expired(self, n: int = 1) -> None:
+        with self._lock:
+            self.expired_in_flight += n
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            elapsed = max(time.monotonic() - self._t0, 1e-9)
+            out: Dict[str, float] = {"elapsed_s": round(elapsed, 3)}
+            for s in self.STAGES:
+                out[f"{s}_busy_s"] = round(self.stage_busy_s[s], 4)
+                out[f"{s}_occupancy"] = round(self.stage_busy_s[s] / elapsed, 4)
+                out[f"{s}_batches"] = float(self.stage_batches[s])
+            out["rows"] = float(self.rows)
+            out["in_flight_peak"] = float(self.in_flight_peak)
+            out["expired_in_flight"] = float(self.expired_in_flight)
+            out["immediate_dispatches"] = float(self.immediate_dispatches)
+            out["coalesced_dispatches"] = float(self.coalesced_dispatches)
+            return out
+
+
 @contextlib.contextmanager
 def profile_to(logdir: str) -> Iterator[None]:
     """Capture a jax.profiler device trace into `logdir` (TensorBoard

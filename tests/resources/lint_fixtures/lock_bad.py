@@ -1,0 +1,27 @@
+"""Fixture for the blocking-host-work-under-lock rule: host JSON/serving
+work inside a model-lock critical section. Parsed, never imported."""
+
+import json
+
+from mmlspark_tpu.serving import make_reply, parse_request
+
+
+class BadEngine:
+    def score_batch(self, df, body):
+        with self._model_lock:
+            obj = json.loads(body)  # expect[blocking-host-work-under-lock]
+            parsed = parse_request(df)  # expect[blocking-host-work-under-lock]
+            out = self.handler(parsed)  # opaque handler call: clean
+            reply = self.sugar.make_reply(out, "y")  # expect[blocking-host-work-under-lock]
+            blob = json.dumps({"y": 1})  # expect[blocking-host-work-under-lock]
+            tiny = json.dumps({})  # control-plane ping  # graftcheck: ignore[blocking-host-work-under-lock]  # expect-suppressed[blocking-host-work-under-lock]
+        return obj, reply, blob, tiny
+
+    def fine_outside(self, df):
+        with self._model_lock:
+            scored = self.model(df)
+        return json.dumps({"y": scored})  # outside the lock: clean
+
+    def other_lock_is_fine(self, rows):
+        with self._stats_lock:
+            return json.dumps(rows)  # not a configured model lock: clean

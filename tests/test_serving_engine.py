@@ -1,0 +1,436 @@
+"""Pipelined serving engine tests (ISSUE 4 tentpole + satellites).
+
+The headline guarantees, verified with jax.transfer_guard and the engine's
+own counters rather than vibes:
+
+- parse-stage uploads and reply-stage syncs happen OUTSIDE the score
+  stage's critical section — the whole server runs with the score stage
+  under jax.transfer_guard("disallow_explicit") and still answers correctly;
+- adaptive coalescing: a lone request on an idle engine dispatches
+  immediately (no max_wait stall), a burst behind a busy score stage
+  coalesces;
+- shutdown under load drains pending (503) and in-flight (real replies)
+  work with no leaked engine threads;
+- a request that expires while its batch is in flight is skipped and
+  counted (expired_in_flight), not served to a client that already got 504;
+- malformed rows under a VECTOR schema get per-row 400s, not batch 500s;
+- continuous mode records stage timings so stage_summary() works there too.
+"""
+
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.dnn import mlp
+from mmlspark_tpu.dnn.network import NetworkBundle
+from mmlspark_tpu.io.http import HTTPRequestData
+from mmlspark_tpu.models import TPUModel
+from mmlspark_tpu.serving import (
+    MALFORMED_COL,
+    PipelineServingHandler,
+    ServingServer,
+    StagedServingHandler,
+    make_reply,
+    parse_request,
+)
+from mmlspark_tpu.stages.batching import AdaptiveBatchPolicy
+
+
+def _post(url, obj, timeout=10.0):
+    req = urllib.request.Request(
+        url, json.dumps(obj).encode(), {"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, None
+
+
+def _request_frame(payloads):
+    """[id, request] frame as the HTTP front end would build it — for
+    warming staged handlers without a socket."""
+    reqs = np.empty(len(payloads), object)
+    reqs[:] = [
+        HTTPRequestData.post_json("http://localhost/api", json.dumps(p))
+        for p in payloads
+    ]
+    ids = np.empty(len(payloads), object)
+    ids[:] = [{"requestId": str(i), "partitionId": 0} for i in range(len(payloads))]
+    return DataFrame.from_dict(
+        {"id": ids, "request": reqs},
+        types={"id": DataType.STRUCT, "request": DataType.STRUCT},
+    )
+
+
+def _tpu_handler(value_col="scores", use_mesh=False):
+    net = mlp(4, [6], 3)
+    bundle = NetworkBundle(net, net.init(jax.random.PRNGKey(0)))
+    model = TPUModel(bundle, input_col="x", output_col=value_col,
+                     mini_batch_size=8)
+    return PipelineServingHandler(
+        model, {"x": (DataType.VECTOR, 4)}, value_col=value_col,
+        use_mesh=use_mesh,
+    )
+
+
+def _serve_threads():
+    return [t for t in threading.enumerate() if t.name.startswith("serve-")]
+
+
+def _assert_no_serve_threads():
+    deadline = time.monotonic() + 5.0
+    while _serve_threads() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not _serve_threads(), [t.name for t in _serve_threads()]
+
+
+# -- the tentpole guarantee ----------------------------------------------------
+
+
+def test_score_stage_transfer_free_under_guard():
+    """THE acceptance test: with the score stage wrapped in
+    jax.transfer_guard("disallow_explicit") (guard_score=True), the pipelined engine
+    serves correct replies — every h2d upload happened in the parse stage
+    and every d2h sync in the reply stage, so the device never waits on
+    JSON work inside the score critical section."""
+    handler = _tpu_handler()
+    # warm compiles + the bundle's weight upload OUTSIDE the guard (the
+    # first score of a fresh model legitimately uploads weights once)
+    for n in (1, 2):
+        handler.reply(handler.score(handler.parse(
+            _request_frame([{"x": [0.1] * 4}] * n)
+        )))
+
+    expected = np.asarray(
+        handler.score(handler.parse(_request_frame([{"x": [0.5] * 4}])))
+        .column("scores").values
+    )[0]
+
+    with ServingServer(
+        handler, api_name="guarded", mode="micro_batch", engine="pipelined",
+        guard_score=True, max_wait_ms=2.0,
+    ) as server:
+        for _ in range(3):
+            status, body = _post(server.url, {"x": [0.5] * 4})
+            assert status == 200
+            np.testing.assert_allclose(np.asarray(body), expected, rtol=1e-5)
+        # per-stage transfer attribution: uploads landed in parse batches,
+        # syncs in reply batches
+        entries = list(server.stage_timings)
+        assert entries and all(e["h2d_transfers"] >= 1 for e in entries), entries
+        assert all(e["d2h_transfers"] >= 1 for e in entries), entries
+        summary = server.pipeline_summary()
+        assert summary["score_batches"] >= 3
+        assert summary["in_flight_peak"] <= 2
+    _assert_no_serve_threads()
+
+
+def test_guard_score_is_live_on_sync_engine_too():
+    """guard_score must not be a silent no-op outside the pipelined engine:
+    on the sync engine the whole handler runs under the lock, so a staged
+    handler whose parse uploads trips the guard (500), while the pipelined
+    engine keeps those transfers outside the guarded score stage (200)."""
+    handler = _tpu_handler()
+    handler.reply(handler.score(handler.parse(  # warm compiles + weights
+        _request_frame([{"x": [0.1] * 4}])
+    )))
+    with ServingServer(
+        handler, api_name="g", mode="micro_batch", engine="sync",
+        guard_score=True, max_wait_ms=2.0,
+    ) as server:
+        status, _ = _post(server.url, {"x": [0.5] * 4})
+        assert status == 500  # parse's h2d ran under the guarded lock
+    _assert_no_serve_threads()
+
+
+def test_plain_callable_handler_still_works_on_pipelined_engine():
+    """Backward compat: a plain handler function runs whole inside the
+    score stage and keeps its semantics."""
+
+    def handler(df):
+        parsed = parse_request(df)
+        vals = np.asarray([float(v) for v in parsed["x"]])
+        return make_reply(parsed.with_column("y", vals * 3.0, DataType.DOUBLE), "y")
+
+    with ServingServer(handler, api_name="plain", mode="micro_batch") as server:
+        assert _post(server.url, {"x": 7}) == (200, 21.0)
+    _assert_no_serve_threads()
+
+
+def test_staged_handler_call_chains_stages_for_continuous_mode():
+    handler = _tpu_handler()
+    with ServingServer(handler, api_name="cont") as server:  # continuous
+        status, body = _post(server.url, {"x": [1.0, 0.0, -1.0, 2.0]})
+        assert status == 200 and len(body) == 3
+
+
+# -- adaptive coalescing -------------------------------------------------------
+
+
+def test_adaptive_policy_unit():
+    p = AdaptiveBatchPolicy(8, 5.0)
+    assert not p.should_dispatch(0, 0.0, 0)          # nothing queued
+    assert p.should_dispatch(1, 0.0, 0)              # idle: go now
+    assert not p.should_dispatch(3, 0.0, 1)          # busy: stretch
+    assert p.should_dispatch(3, 5.0, 1)              # deadline lapsed
+    assert p.should_dispatch(8, 0.0, 4)              # batch full
+    assert p.wait_budget_s(2.0) == pytest.approx(0.003)
+    assert p.wait_budget_s(9.0) == 0.0
+    with pytest.raises(ValueError):
+        AdaptiveBatchPolicy(0, 5.0)
+
+
+def test_idle_engine_dispatches_immediately_despite_large_max_wait():
+    """The old sync engine waited up to max_wait_ms even for a lone request
+    on an idle device; the adaptive dispatcher must not."""
+
+    def handler(df):
+        parsed = parse_request(df)
+        return make_reply(parsed.with_column("y", parsed["x"]), "y")
+
+    with ServingServer(
+        handler, api_name="idle", mode="micro_batch", max_wait_ms=1500.0
+    ) as server:
+        t0 = time.monotonic()
+        status, _ = _post(server.url, {"x": 1})
+        elapsed = time.monotonic() - t0
+        assert status == 200
+        assert elapsed < 1.0, f"idle dispatch took {elapsed:.3f}s"
+        assert server.pipeline_summary()["immediate_dispatches"] >= 1
+
+
+def test_burst_behind_busy_score_stage_coalesces():
+    sizes = []
+
+    class Slow(StagedServingHandler):
+        def score(self, df):
+            sizes.append(len(df))
+            time.sleep(0.06)
+            parsed = parse_request(df)
+            return make_reply(parsed.with_column("y", parsed["x"]), "y")
+
+    with ServingServer(
+        Slow(), api_name="burst", mode="micro_batch",
+        max_batch_size=16, max_wait_ms=40.0,
+    ) as server:
+        threads = [
+            threading.Thread(target=_post, args=(server.url, {"x": i}))
+            for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert sum(sizes) == 8
+    assert max(sizes) > 1, sizes  # stretched while score was busy
+    _assert_no_serve_threads()
+
+
+# -- shutdown under load (satellite) -------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["pipelined", "sync"])
+def test_shutdown_under_load_drains_and_leaks_no_threads(engine):
+    """Pending requests get 503, in-parse/in-flight batches drain with real
+    replies, and every engine thread is joined — no daemon stuck in
+    _run_batch."""
+
+    class Slow(StagedServingHandler):
+        def score(self, df):
+            time.sleep(0.08)
+            parsed = parse_request(df)
+            return make_reply(parsed.with_column("y", parsed["x"]), "y")
+
+    results = []
+    lock = threading.Lock()
+
+    def client(i, url):
+        try:
+            status, body = _post(url, {"x": i}, timeout=15.0)
+        except (OSError, http.client.HTTPException):
+            # URLError/refused/reset/RemoteDisconnected: the connection was
+            # never handled (or was torn down) before a worker picked it up
+            # — nothing was accepted into the engine, so nothing to drain
+            status, body = "refused", None
+        with lock:
+            results.append((status, body))
+
+    server = ServingServer(
+        Slow(), api_name="drain", mode="micro_batch", engine=engine,
+        max_batch_size=2, max_wait_ms=2.0,
+    ).start()
+    threads = [
+        threading.Thread(target=client, args=(i, server.url)) for i in range(10)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.12)  # let some batches get in flight, keep some queued
+    server.stop()
+    for t in threads:
+        t.join(timeout=20.0)
+    assert not any(t.is_alive() for t in threads)
+
+    assert len(results) == 10  # every client got SOME answer
+    statuses = {s for s, _ in results}
+    assert statuses <= {200, 503, "refused"}, statuses
+    assert 200 in statuses  # in-flight work drained with real replies
+    for status, body in results:
+        if status == 200:
+            assert body is not None
+    _assert_no_serve_threads()
+
+
+# -- expired in flight (satellite) ---------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["pipelined", "sync"])
+def test_request_expiring_in_flight_is_skipped_and_counted(engine):
+    class VerySlow(StagedServingHandler):
+        def score(self, df):
+            time.sleep(0.6)
+            parsed = parse_request(df)
+            return make_reply(parsed.with_column("y", parsed["x"]), "y")
+
+    with ServingServer(
+        VerySlow(), api_name="exp", mode="micro_batch", engine=engine,
+        request_timeout=0.25, max_wait_ms=2.0,
+    ) as server:
+        status, _ = _post(server.url, {"x": 1}, timeout=10.0)
+        assert status == 504  # the client gave up at request_timeout
+        deadline = time.monotonic() + 3.0
+        while server.expired_in_flight == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert server.expired_in_flight >= 1
+    _assert_no_serve_threads()
+
+
+# -- malformed rows under VECTOR schema (satellite) ----------------------------
+
+
+def test_parse_request_marks_malformed_vector_rows_instead_of_raising():
+    frame = _request_frame([
+        {"x": [1.0, 2.0]},
+        {},                      # missing key
+        {"x": [1.0, 2.0, 3.0]},  # ragged vs the batch
+        {"x": "abc"},            # non-numeric
+        {"x": None},             # explicit null
+    ])
+    parsed = parse_request(frame, {"x": DataType.VECTOR})
+    assert parsed.column("x").values.shape == (5, 2)  # dim from first good row
+    markers = parsed.column(MALFORMED_COL).values
+    assert markers[0] is None
+    assert all(m is not None for m in markers[1:])
+
+    replied = make_reply(parsed, "x")
+    codes = [r.status_line.status_code for r in replied.column("reply").values]
+    assert codes == [200, 400, 400, 400, 400]
+
+
+def test_malformed_row_gets_400_and_batch_survives_end_to_end():
+    handler = _tpu_handler()
+    with ServingServer(
+        handler, api_name="rows", mode="micro_batch", max_wait_ms=2.0
+    ) as server:
+        ok_status, ok_body = _post(server.url, {"x": [0.5] * 4})
+        bad_status, _ = _post(server.url, {"x": [1.0, 2.0]})  # wrong length
+        none_status, _ = _post(server.url, {})
+        ok2_status, ok2_body = _post(server.url, {"x": [0.5] * 4})
+    assert ok_status == 200 and len(ok_body) == 3
+    assert bad_status == 400 and none_status == 400
+    assert ok2_status == 200 and ok2_body == ok_body  # server kept serving
+    _assert_no_serve_threads()
+
+
+def test_parse_request_undeclared_dim_uses_modal_length():
+    """One short row batched AHEAD of good rows must not redefine the
+    batch's expected dim and 400 the valid clients."""
+    frame = _request_frame([
+        {"x": [9.0, 9.0]},            # the one bad (short) row, first
+        {"x": [1.0, 2.0, 3.0, 4.0]},
+        {"x": [5.0, 6.0, 7.0, 8.0]},
+        {"x": [9.0, 8.0, 7.0, 6.0]},
+    ])
+    parsed = parse_request(frame, {"x": DataType.VECTOR})
+    assert parsed.column("x").values.shape == (4, 4)
+    markers = parsed.column(MALFORMED_COL).values
+    assert markers[0] is not None
+    assert all(m is None for m in markers[1:])
+
+
+def test_parse_request_all_rows_malformed_does_not_crash():
+    parsed = parse_request(
+        _request_frame([{}, {"x": "?"}]), {"x": DataType.VECTOR}
+    )
+    assert parsed.column("x").values.shape == (2, 1)  # fallback dim
+    assert all(m is not None for m in parsed.column(MALFORMED_COL).values)
+
+
+# -- continuous-mode stage timings (satellite) ---------------------------------
+
+
+def test_continuous_mode_records_stage_timings():
+    def handler(df):
+        parsed = parse_request(df)
+        return make_reply(parsed.with_column("y", parsed["x"]), "y")
+
+    with ServingServer(handler, api_name="t") as server:
+        for i in range(3):
+            assert _post(server.url, {"x": i})[0] == 200
+        assert len(server.stage_timings) == 3
+        assert all(t["queue_wait_ms"] == 0.0 for t in server.stage_timings)
+        summary = server.stage_summary()
+        assert summary["n_sampled"] == 3.0
+        assert "handler_ms_p50" in summary and "lock_wait_ms_p99" in summary
+
+
+# -- mesh wiring ---------------------------------------------------------------
+
+
+def test_shard_frame_device_stages_numeric_columns():
+    from mmlspark_tpu.parallel.mesh import DATA_AXIS, data_parallel_mesh, shard_frame
+
+    mesh = data_parallel_mesh()
+    n_data = mesh.shape[DATA_AXIS]
+    # divisible rows: the upload keeps its NamedSharding on the data axis
+    df = DataFrame.from_dict({
+        "x": np.ones((n_data, 3), np.float32),
+        "tag": np.empty(n_data, object),
+    })
+    out = shard_frame(mesh, df)
+    assert out.column("x").is_device_backed
+    assert not out.column("tag").is_device_backed
+    sharding = out.column("x").device_values().sharding
+    assert DATA_AXIS in sharding.mesh.axis_names
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.ones((n_data, 3)))
+
+    # ragged rows: padded to a data-axis multiple and trimmed ON DEVICE
+    ragged = DataFrame.from_dict({"x": np.ones((n_data + 1, 3), np.float32)})
+    out = shard_frame(mesh, ragged)
+    assert out.column("x").is_device_backed
+    assert out.column("x").shape == (n_data + 1, 3)
+
+
+def test_serve_pipeline_use_mesh_shards_parse_stage_uploads():
+    """A mesh handler serves unchanged user payloads: parse-stage uploads go
+    through parallel/mesh.shard_batch sharding (data axis), the score stage
+    consumes device-backed columns."""
+    handler = _tpu_handler(use_mesh=True)
+    parsed = handler.parse(_request_frame([{"x": [0.2] * 4}] * 2))
+    assert parsed.column("x").is_device_backed
+
+    with ServingServer(
+        handler, api_name="mesh", mode="micro_batch", max_wait_ms=2.0
+    ) as server:
+        status, body = _post(server.url, {"x": [0.2] * 4})
+        assert status == 200 and len(body) == 3
+    _assert_no_serve_threads()

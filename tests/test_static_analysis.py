@@ -141,6 +141,44 @@ def test_host_sync_rule_ignores_non_transform_functions():
     assert all(f.line < fit_line for f in findings)
 
 
+# -- lock scope ---------------------------------------------------------------
+
+
+def test_blocking_host_work_under_lock_fires_and_suppresses():
+    from mmlspark_tpu.analysis.lock_scope import check_lock_scope
+
+    path = os.path.join(FIXTURES, "lock_bad.py")
+    findings = check_lock_scope([path], repo_root=FIXTURES)
+    _assert_matches_markers("lock_bad.py", findings)
+
+
+def test_lock_scope_rule_honors_configured_lock_names():
+    """The `_stats_lock` block in the fixture is clean by default; naming it
+    in lock_names turns its json.dumps into a finding."""
+    from mmlspark_tpu.analysis.lock_scope import check_lock_scope
+
+    path = os.path.join(FIXTURES, "lock_bad.py")
+    findings = check_lock_scope(
+        [path], repo_root=FIXTURES, lock_names=["_stats_lock"]
+    )
+    assert len(findings) == 1
+    with open(path) as f:
+        stats_line = next(
+            i for i, line in enumerate(f, start=1)
+            if "not a configured model lock" in line
+        )
+    assert findings[0].line == stats_line
+
+
+def test_lock_scope_config_key_loads(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.graftcheck]\nlock_names = ["_engine_lock"]\n'
+    )
+    cfg = load_config(str(tmp_path))
+    assert cfg.lock_names == ["_engine_lock"]
+    assert load_config(REPO).lock_names == ["_model_lock"]  # default
+
+
 # -- schema flow --------------------------------------------------------------
 
 
