@@ -34,6 +34,7 @@ device_puts collapse tunnel throughput ~50x) and results are fetched once
 (per-fetch D2H latency ~100 ms).
 """
 
+import contextlib
 import json
 import sys
 import time
@@ -601,6 +602,51 @@ def run_smoke(out_path: str = "BENCH_pr03.json") -> dict:
     return report
 
 
+def _closed_loop_load(port, route, n_clients, n_requests, payload_fn,
+                      errors_tag="serving load"):
+    """Shared closed-loop HTTP harness for the serving smokes: n_clients
+    keep-alive clients, n_requests each, payload_fn(cid) -> body bytes.
+    Returns (wall seconds, sorted per-request latencies)."""
+    import http.client
+    import threading
+
+    lat, errors, lock = [], [], threading.Lock()
+
+    def client(cid):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            body = payload_fn(cid)
+            for _ in range(n_requests):
+                t0 = time.perf_counter()
+                conn.request("POST", route, body,
+                             {"Content-Type": "application/json"})
+                r = conn.getresponse()
+                r.read()
+                dt = time.perf_counter() - t0
+                with lock:
+                    if r.status != 200:
+                        errors.append(r.status)
+                    else:
+                        lat.append(dt)
+            conn.close()
+        except Exception as e:  # surface, don't die silently
+            with lock:
+                errors.append(repr(e))
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(n_clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors or not lat:
+        raise RuntimeError(f"{errors_tag} errors: {errors[:5]}")
+    return wall, sorted(lat)
+
+
 def run_serving_smoke(out_path: str = "BENCH_pr04.json") -> dict:
     """Serving-engine smoke bench (CPU-safe; wired into tier-1 via
     tests/test_bench_smoke.py): closed-loop 4-client throughput + latency
@@ -619,7 +665,6 @@ def run_serving_smoke(out_path: str = "BENCH_pr04.json") -> dict:
     is exactly the effect being measured.
     """
     import http.client
-    import threading
 
     import jax
     import jax.numpy as jnp
@@ -663,41 +708,11 @@ def run_serving_smoke(out_path: str = "BENCH_pr04.json") -> dict:
             return make_reply(df, "y")  # .values inside = the d2h sync
 
     def closed_loop(port, n_requests):
-        lat, errors, lock = [], [], threading.Lock()
-
-        def client(cid):
-            try:
-                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
-                body = json.dumps({"x": [float(cid)] * DIM}).encode()
-                for _ in range(n_requests):
-                    t0 = time.perf_counter()
-                    conn.request("POST", "/engine", body,
-                                 {"Content-Type": "application/json"})
-                    r = conn.getresponse()
-                    r.read()
-                    dt = time.perf_counter() - t0
-                    with lock:
-                        if r.status != 200:
-                            errors.append(r.status)
-                        else:
-                            lat.append(dt)
-                conn.close()
-            except Exception as e:  # surface, don't die silently
-                with lock:
-                    errors.append(repr(e))
-
-        threads = [
-            threading.Thread(target=client, args=(i,)) for i in range(N_CLIENTS)
-        ]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
-        if errors or not lat:
-            raise RuntimeError(f"serving smoke errors: {errors[:5]}")
-        return wall, sorted(lat)
+        return _closed_loop_load(
+            port, "/engine", N_CLIENTS, n_requests,
+            lambda cid: json.dumps({"x": [float(cid)] * DIM}).encode(),
+            errors_tag="serving smoke",
+        )
 
     handler = _SmokeStaged()  # ONE handler: both engines share compiles
 
@@ -742,6 +757,184 @@ def run_serving_smoke(out_path: str = "BENCH_pr04.json") -> dict:
             "throughput_speedup": round(
                 pipe_stats["throughput_rps"] / sync_stats["throughput_rps"], 3
             ),
+        },
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return report
+
+
+def run_obs_overhead_smoke(out_path: str = "BENCH_pr05.json") -> dict:
+    """Observability-overhead smoke bench (CPU-safe; wired into tier-1 via
+    tests/test_bench_smoke.py): the SAME staged serving workload measured
+    with the full observability layer on (metrics registry + request
+    tracing, the default) vs `obs.disabled()` (every instrument and span a
+    no-op). ISSUE 5 acceptance: instrumentation costs <= 5% closed-loop
+    throughput, `GET /metrics` scrapes and parses mid-load with the
+    required families present, `GET /healthz` returns live engine state,
+    and a traced request yields the full http -> parse -> score -> reply
+    span tree exportable as Chrome trace events. Written to BENCH_pr05.json.
+
+    Per-row host cost is padded (PER_ROW_S) exactly like run_serving_smoke
+    so the ratio reflects instrumentation overhead against a realistic
+    request cost, not against an empty loop where any fixed cost looks
+    enormous."""
+    import http.client
+
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.core.dataframe import DataType
+    from mmlspark_tpu.serving import (
+        ServingServer,
+        StagedServingHandler,
+        make_reply,
+        parse_request,
+    )
+
+    PER_ROW_S = 3e-3
+    DIM = 16
+    N_CLIENTS = 4
+    N_REQUESTS = 20
+
+    class _ObsStaged(StagedServingHandler):
+        def __init__(self):
+            self._w = jax.device_put(
+                np.random.default_rng(0).normal(size=(DIM, DIM)).astype(np.float32)
+            )
+            self._fn = jax.jit(lambda w, x: jnp.tanh(x @ w))
+
+        def parse(self, df):
+            parsed = parse_request(df, {"x": DataType.VECTOR})
+            time.sleep(PER_ROW_S * len(df))
+            parsed.column("x").device_values()
+            return parsed
+
+        def score(self, df):
+            y = self._fn(self._w, df.column("x").device_values())
+            time.sleep(PER_ROW_S * len(df))
+            return df.with_column("y", y, DataType.VECTOR)
+
+        def reply(self, df):
+            time.sleep(PER_ROW_S * len(df))
+            return make_reply(df, "y")
+
+    def closed_loop(port, n_requests):
+        return _closed_loop_load(
+            port, "/obs", N_CLIENTS, n_requests,
+            lambda cid: json.dumps({"x": [float(cid)] * DIM}).encode(),
+            errors_tag="obs smoke",
+        )
+
+    def http_get(port, route):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", route)
+        r = conn.getresponse()
+        body = r.read()
+        conn.close()
+        return r.status, body
+
+    handler = _ObsStaged()  # shared: both arms reuse the same compiles
+
+    def measure(instrumented: bool):
+        ctx = contextlib.nullcontext() if instrumented else obs.disabled()
+        with ctx:
+            with ServingServer(
+                handler, api_name="obs", mode="micro_batch",
+                max_batch_size=N_CLIENTS, max_wait_ms=2.0,
+            ) as srv:
+                closed_loop(srv.port, 5)  # warm compiles per batch size
+                wall, lat = closed_loop(srv.port, N_REQUESTS)
+                stats = {
+                    "throughput_rps": round(N_CLIENTS * N_REQUESTS / wall, 1),
+                    "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+                    "p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 3),
+                    "wall_s": round(wall, 3),
+                }
+                if instrumented:
+                    # the acceptance surfaces, exercised against the LIVE
+                    # loaded server: scrape parses, health is green
+                    from mmlspark_tpu.obs.metrics import parse_prometheus
+
+                    code, body = http_get(srv.port, "/metrics")
+                    assert code == 200, code
+                    samples = parse_prometheus(body.decode("utf-8"))
+                    names = {name for name, _ in samples}
+                    required = {
+                        "serving_request_latency_ms_count",
+                        "serving_stage_busy_seconds_total",
+                        "serving_stage_occupancy",
+                        "dataplane_h2d_transfers_total",
+                        "dataplane_d2h_transfers_total",
+                        "dataplane_compiles_total",
+                    }
+                    stats["metrics_scrape"] = {
+                        "samples": len(samples),
+                        "required_present": sorted(required - names) == [],
+                    }
+                    code, body = http_get(srv.port, "/healthz")
+                    health = json.loads(body)
+                    stats["healthz"] = {
+                        "code": code,
+                        "status": health.get("status"),
+                        "threads_alive": all(
+                            health.get("threads", {}).values()
+                        ),
+                    }
+        return stats
+
+    from mmlspark_tpu.obs import tracer
+
+    tracer().clear()  # the trace assertions below want THIS run's spans
+    # Alternate arms and keep the best round of each: a fixed order would
+    # bill cold-process warm-up (imports, thread-pool spin-up, first-run
+    # scheduler state) to whichever arm ran first — measured at up to ~25%
+    # phantom "overhead" on a cold CI process, versus ~0% once warm.
+    rounds = [
+        measure(instrumented=True), measure(instrumented=False),
+        measure(instrumented=True), measure(instrumented=False),
+    ]
+    instrumented = max(rounds[0], rounds[2],
+                       key=lambda s: s["throughput_rps"])
+    disabled = max(rounds[1], rounds[3], key=lambda s: s["throughput_rps"])
+    # span-tree acceptance: some request from the instrumented runs carries
+    # the full stage path, and it exports to Chrome trace events
+    span_names_by_trace: dict = {}
+    for s in tracer().spans():
+        span_names_by_trace.setdefault(s.trace_id, set()).add(s.name)
+    full = [
+        tid for tid, names in span_names_by_trace.items()
+        if {"http", "parse", "score", "reply"} <= names
+    ]
+    trace_report = {"full_span_trees": len(full)}
+    if full:
+        events = tracer().chrome_trace(full[0])["traceEvents"]
+        trace_report["chrome_events"] = len(events)
+        trace_report["chrome_span_names"] = sorted(
+            {e["name"] for e in events if e["ph"] == "X"}
+        )
+
+    speed_ratio = (
+        instrumented["throughput_rps"] / disabled["throughput_rps"]
+    )
+    report = {
+        "pr": 5,
+        "platform": jax.default_backend(),
+        "obs_overhead": {
+            "workload": {
+                "clients": N_CLIENTS,
+                "requests_per_client": N_REQUESTS,
+                "per_row_host_ms": PER_ROW_S * 1e3,
+                "dim": DIM,
+            },
+            "instrumented": instrumented,
+            "disabled": disabled,
+            "throughput_ratio": round(speed_ratio, 4),
+            "overhead_frac": round(max(0.0, 1.0 - speed_ratio), 4),
+            "trace": trace_report,
         },
     }
     if out_path:
@@ -802,5 +995,6 @@ if __name__ == "__main__":
     if "--smoke" in sys.argv[1:]:
         print(json.dumps(run_smoke(), sort_keys=True))
         print(json.dumps(run_serving_smoke(), sort_keys=True))
+        print(json.dumps(run_obs_overhead_smoke(), sort_keys=True))
         sys.exit(0)
     sys.exit(main())

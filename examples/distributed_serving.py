@@ -93,6 +93,20 @@ def main() -> None:
         for w, worker in enumerate(srv.workers):
             print(f"worker {w} stages:", worker.stage_summary())
 
+        # observability surfaces (docs/observability.md): scrape the
+        # gateway exactly like Prometheus / a load balancer probe would
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        conn.request("GET", "/metrics")
+        metrics_text = conn.getresponse().read().decode()
+        conn.close()
+        assert health["status"] == "ok", health
+        assert "serving_request_latency_ms" in metrics_text
+        print(f"gateway healthz: {health['status']} "
+              f"({len(health['workers'])} workers); /metrics "
+              f"{len(metrics_text.splitlines())} lines")
+
     acc = float(((offline > 0.5) == y[:80]).mean())
     print(f"served 80 requests over 4 clients; agreement with offline "
           f"scoring exact; model train-acc on served rows {acc:.2f}")

@@ -28,6 +28,8 @@ RULES: Dict[str, str] = {
     "host-sync-in-hot-path": "np.asarray/float()/block_until_ready on device-backed column values inside transform",
     # lock-scope family (lock_scope.py)
     "blocking-host-work-under-lock": "json.loads/json.dumps/parse_request/make_reply inside a model-lock critical section starves device dispatch",
+    # monotonic-time family (monotonic_time.py)
+    "non-monotonic-duration": "time.time() feeding a duration/deadline computation; use time.monotonic/perf_counter",
     # Params-contract family (params_contract.py)
     "param-converter": "simple Param declared without an explicit type converter",
     "param-doc": "stage or Param missing documentation",

@@ -1,0 +1,161 @@
+"""Monotonic-time rule: durations and deadlines must not use wall-clock.
+
+`non-monotonic-duration` flags `time.time()` readings that feed a duration
+or deadline computation inside `mmlspark_tpu/`. Wall-clock steps under NTP
+slew/step and DST — a serving deadline computed from `time.time()` can
+expire a request early (or never), and a benchmark delta can go negative.
+`time.monotonic()` (deadlines, occupancy) and `time.perf_counter()`
+(fine-grained timing) are the correct sources; `time.time()` is legitimate
+ONLY as an absolute timestamp (log records, export anchors).
+
+Flagged, per function scope (module top-level counts as a scope):
+
+- any binary subtraction where either operand is (derived from) a
+  ``time.time()`` reading — the duration idiom ``time.time() - t0``;
+- any comparison involving such a value — the deadline idiom
+  ``if time.time() > deadline``.
+
+Taint is intraprocedural, like the hot-path rule: names assigned from an
+expression containing ``time.time()`` (or an already-tainted name) carry
+the taint, so ``t0 = time.time() ... elapsed = now - t0`` is caught even
+when the subtraction itself never mentions `time`. A bare ``time.time()``
+with no arithmetic (an honest timestamp) is NOT flagged. Justified uses
+take ``# graftcheck: ignore[non-monotonic-duration]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Set
+
+from mmlspark_tpu.analysis.base import Finding
+
+_RULE = "non-monotonic-duration"
+
+
+class _TimeAliases:
+    """How this module can spell a wall-clock read: `X.time()` for every
+    `import time as X`, plus bare `Y()` for every `from time import time
+    as Y`."""
+
+    def __init__(self, tree: ast.AST):
+        self.module_names: Set[str] = set()
+        self.func_names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "time":
+                        self.module_names.add(alias.asname or "time")
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for alias in node.names:
+                    if alias.name == "time":
+                        self.func_names.add(alias.asname or "time")
+
+
+def _is_wall_clock_call(node: ast.AST, aliases: _TimeAliases) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if (
+        isinstance(func, ast.Attribute)
+        and func.attr == "time"
+        and isinstance(func.value, ast.Name)
+        and func.value.id in aliases.module_names
+    ):
+        return True
+    return isinstance(func, ast.Name) and func.id in aliases.func_names
+
+
+def _contains_wall_read(node: ast.AST, tainted: Set[str],
+                        aliases: _TimeAliases) -> bool:
+    for sub in ast.walk(node):
+        if _is_wall_clock_call(sub, aliases):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+    return False
+
+
+def _scan_scope(scope: ast.AST, rel: str, aliases: _TimeAliases,
+                findings: List[Finding]) -> None:
+    """One function (or the module top level): propagate taint through
+    assignments in document order, flag Sub/Compare touching the taint."""
+    tainted: Set[str] = set()
+    flagged_lines: Set[int] = set()
+
+    def flag(node: ast.AST, what: str) -> None:
+        if node.lineno in flagged_lines:
+            return
+        flagged_lines.add(node.lineno)
+        findings.append(Finding(
+            _RULE, rel, node.lineno,
+            f"time.time() used in a {what}; wall-clock steps under "
+            "NTP/DST — use time.monotonic() (deadlines) or "
+            "time.perf_counter() (durations)",
+        ))
+
+    for node in _walk_scope(scope):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = node.value
+            if value is not None and _contains_wall_read(
+                value, tainted, aliases
+            ):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            if _contains_wall_read(
+                node.left, tainted, aliases
+            ) or _contains_wall_read(node.right, tainted, aliases):
+                flag(node, "duration subtraction")
+        elif isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            if any(_contains_wall_read(s, tainted, aliases) for s in sides):
+                flag(node, "deadline comparison")
+
+
+def _walk_scope(scope: ast.AST) -> Iterable[ast.AST]:
+    """Pre-order (document-order) walk of a scope WITHOUT descending into
+    nested function/class bodies (each gets its own taint set — a closure
+    timing itself correctly must not inherit the enclosing scope's wall
+    reads). Document order matters: a `t0 = time.time()` nested inside an
+    `if` must taint `t0` BEFORE a later top-level `now - t0` is checked —
+    breadth-first traversal would visit the use before the assignment."""
+    body = scope.body if hasattr(scope, "body") else []
+    stack = list(reversed(body))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+
+def check_monotonic_time(
+    paths: Iterable[str], repo_root: Optional[str] = None
+) -> List[Finding]:
+    repo_root = repo_root or os.getcwd()
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, repo_root)
+        aliases = _TimeAliases(tree)
+        if not (aliases.module_names or aliases.func_names):
+            continue  # module never imports time: nothing to read
+        _scan_scope(tree, rel, aliases, findings)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_scope(node, rel, aliases, findings)
+    return findings

@@ -92,6 +92,10 @@ def run_all(
         findings += check_lock_scope(
             package_files, repo_root=root, lock_names=cfg.lock_names
         )
+    if "non-monotonic-duration" in enabled:
+        from mmlspark_tpu.analysis.monotonic_time import check_monotonic_time
+
+        findings += check_monotonic_time(package_files, repo_root=root)
     if enabled & _PARAM_RULES:
         from mmlspark_tpu.analysis.params_contract import check_params_contract
 

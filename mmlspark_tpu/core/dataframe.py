@@ -57,6 +57,17 @@ def _counters():
     return dataplane_counters()
 
 
+def _trace_transfer(kind: str, nbytes: int) -> None:
+    """Annotate the active trace span (if any) with a host<->device sync —
+    slow-request logs then show WHICH stage paid a transfer, not just that
+    one happened somewhere (obs/tracing.py span events)."""
+    from mmlspark_tpu.obs.tracing import current_span
+
+    span = current_span()
+    if span is not None and span.recording:
+        span.add_event(kind, nbytes=int(nbytes))
+
+
 class DataType(enum.Enum):
     DOUBLE = "double"
     FLOAT = "float"
@@ -230,6 +241,7 @@ class Column:
         if storage.host is None:
             host = np.asarray(storage.device)
             _counters().record_d2h(host.nbytes)
+            _trace_transfer("d2h_sync", host.nbytes)
             want = _TYPE_TO_NUMPY.get(self.dtype)
             if want is not None and host.dtype != np.dtype(want) and host.dtype.kind in "fiub":
                 host = host.astype(want)
@@ -260,6 +272,7 @@ class Column:
                 else jax.device_put(host, sharding)
             )
             _counters().record_h2d(host.nbytes)
+            _trace_transfer("h2d_upload", host.nbytes)
         return storage.device
 
     @property

@@ -185,13 +185,27 @@ class DispatchCache:
     """Process-wide cache of compiled callables plus per-shape compile
     accounting. Keys are caller-chosen hashables (TPUModel uses
     (spec, input_shape, dtype)); `compiled` builds-and-caches, `note_dispatch`
-    records the (key, shape) pairs that force an XLA compile."""
+    records the (key, shape) pairs that force an XLA compile.
+
+    Scrape surface (obs/metrics.py): `dispatch_cache_fns` /
+    `dispatch_cache_programs` gauges track retention, and
+    `dispatch_cache_evictions_total` counts FIFO evictions — a rising
+    eviction rate on a serving box means max_fns is too small for the
+    deployed model mix (every eviction is a future recompile)."""
 
     def __init__(self, max_fns: int = 32):
+        from mmlspark_tpu.obs.metrics import registry
+
         self._lock = threading.Lock()
         self._max_fns = max_fns
         self._fns: Dict[Any, Callable] = {}
         self._shapes: set = set()
+        # process-wide eviction tally (an unlabeled counter: every instance
+        # adds to the same series, which is the total the metric means)
+        self._evictions = registry().counter(
+            "dispatch_cache_evictions_total",
+            "Compiled callables evicted FIFO from the dispatch cache",
+        )
 
     def compiled(self, key: Any, build: Callable[[], Callable]) -> Callable:
         with self._lock:
@@ -206,6 +220,7 @@ class DispatchCache:
                 self._shapes = {
                     (k, s) for k, s in self._shapes if k != evicted
                 }
+                self._evictions.inc()
             return self._fns.setdefault(key, fn)
 
     def note_dispatch(self, key: Any, shape: Tuple[int, ...]) -> bool:
@@ -235,6 +250,25 @@ class DispatchCache:
 
 
 _CACHE = DispatchCache()
+
+
+def _register_cache_gauges() -> None:
+    """Size gauges for THE singleton only — registered at module scope so a
+    throwaway DispatchCache instance can never hijack the process series or
+    get pinned by the registry."""
+    from mmlspark_tpu.obs.metrics import registry
+
+    reg = registry()
+    reg.gauge(
+        "dispatch_cache_fns", "Compiled callables currently cached"
+    ).set_function(lambda: float(len(_CACHE._fns)))
+    reg.gauge(
+        "dispatch_cache_programs",
+        "Distinct (program, shape) pairs dispatched",
+    ).set_function(lambda: float(len(_CACHE._shapes)))
+
+
+_register_cache_gauges()
 
 
 def dispatch_cache() -> DispatchCache:

@@ -14,10 +14,37 @@ JSON, complex params via type-dispatched writers (core/serialize.py).
 
 from __future__ import annotations
 
+import time
 from typing import Any, List, Optional, Sequence
 
 from mmlspark_tpu.core.dataframe import DataFrame, Field
 from mmlspark_tpu.core.params import ComplexParam, Params, Wrappable
+
+
+_OBS_HISTS: dict = {}
+
+
+def _obs_hist(key: str):
+    """Process-level pipeline histograms, created once — transform runs
+    inside the serving model lock, which must not pay registry lookups
+    per batch."""
+    if not _OBS_HISTS:
+        from mmlspark_tpu.obs.metrics import registry
+
+        reg = registry()
+        # single update: a concurrent reader must never observe the dict
+        # non-empty but missing a key
+        _OBS_HISTS.update({
+            "stage": reg.histogram(
+                "pipeline_stage_seconds",
+                "Wall seconds per pipeline stage transform", ("stage",),
+            ),
+            "fit": reg.histogram(
+                "pipeline_fit_stage_seconds",
+                "Wall seconds fitting each pipeline stage", ("stage",),
+            ),
+        })
+    return _OBS_HISTS[key]
 
 
 class PipelineStage(Params):
@@ -93,21 +120,32 @@ class Pipeline(Estimator, Wrappable):
         return self.get(self.stages_param)
 
     def fit(self, df: DataFrame) -> "PipelineModel":
+        from mmlspark_tpu.obs import tracer
+
+        fit_hist = _obs_hist("fit")
         fitted: List[Transformer] = []
         current = df
         stages = self.get_stages()
-        for i, stage in enumerate(stages):
-            if isinstance(stage, Estimator):
-                model = stage.fit(current)
-                fitted.append(model)
-                if i < len(stages) - 1:
-                    current = model.transform(current)
-            elif isinstance(stage, Transformer):
-                fitted.append(stage)
-                if i < len(stages) - 1:
-                    current = stage.transform(current)
-            else:
-                raise TypeError(f"Pipeline stage {stage!r} is neither Estimator nor Transformer")
+        with tracer().span("pipeline:fit", stages=len(stages)):
+            for i, stage in enumerate(stages):
+                name = type(stage).__name__
+                t0 = time.perf_counter()
+                with tracer().span(f"fit:{name}", index=i):
+                    if isinstance(stage, Estimator):
+                        model = stage.fit(current)
+                        fitted.append(model)
+                        if i < len(stages) - 1:
+                            current = model.transform(current)
+                    elif isinstance(stage, Transformer):
+                        fitted.append(stage)
+                        if i < len(stages) - 1:
+                            current = stage.transform(current)
+                    else:
+                        raise TypeError(
+                            f"Pipeline stage {stage!r} is neither Estimator "
+                            "nor Transformer"
+                        )
+                fit_hist.labels(stage=name).observe(time.perf_counter() - t0)
         return PipelineModel(fitted)
 
     def transform_schema(self, schema: List[Field]) -> List[Field]:
@@ -134,14 +172,27 @@ class PipelineModel(Model, Wrappable):
         return self.get(self.stages_param)
 
     def transform(self, df: DataFrame) -> DataFrame:
+        from mmlspark_tpu.obs import tracer
         from mmlspark_tpu.utils.profiling import dataplane_counters
 
         counters = dataplane_counters()
+        stage_hist = _obs_hist("stage")
         stats: List[tuple] = []
         for stage in self.get_stages():
+            name = type(stage).__name__
             before = counters.snapshot()
-            df = stage.transform(df)
-            stats.append((type(stage).__name__, counters.delta(before)))
+            t0 = time.perf_counter()
+            # nests under the active request span in serving (the score
+            # stage activates it), so a traced request's tree includes the
+            # per-stage breakdown
+            with tracer().span(f"stage:{name}") as span:
+                df = stage.transform(df)
+                delta = counters.delta(before)
+                for k, v in delta.items():
+                    if v:
+                        span.set_attribute(k, v)
+            stage_hist.labels(stage=name).observe(time.perf_counter() - t0)
+            stats.append((name, delta))
         self.last_stage_dataplane = stats
         return df
 

@@ -19,11 +19,14 @@ normalization) | goss (gradient one-side sampling).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.obs import tracer as obs_tracer
+from mmlspark_tpu.obs.metrics import registry as obs_registry
 from mmlspark_tpu.gbdt.binning import BinMapper
 from mmlspark_tpu.gbdt.booster import Booster
 from mmlspark_tpu.gbdt.objectives import Objective
@@ -153,12 +156,20 @@ def train_booster(
     if hasattr(objective, "prepare"):
         objective.prepare(y, sample_weight)
 
+    tr = obs_tracer()
+    phase_hist = obs_registry().histogram(
+        "gbdt_phase_seconds", "Wall seconds per GBDT training phase",
+        ("phase",),
+    )
     train_rows = (
         ~valid_mask if valid_mask is not None else np.ones(n, bool)
     )
-    binner = BinMapper(cfg.max_bin, cfg.categorical_indexes)
-    binner.fit(x[train_rows])
-    bins = binner.transform(x)
+    t_bin = time.perf_counter()
+    with tr.span("gbdt:binning", rows=n, features=f):
+        binner = BinMapper(cfg.max_bin, cfg.categorical_indexes)
+        binner.fit(x[train_rows])
+        bins = binner.transform(x)
+    phase_hist.labels(phase="binning").observe(time.perf_counter() - t_bin)
     num_bins = binner.max_n_bins
     categorical = [binner.is_categorical(j) for j in range(f)]
 
@@ -419,35 +430,49 @@ def train_booster(
             bank_dev = jax.device_put(np.stack(mask_bank))
         w_arg = w_dev if w_dev is not None else y_dev
         vrows = np.flatnonzero(valid_mask) if has_valid else None
-        result = boost_loop_fused(
-            bins_dev, y_dev, w_arg, raw,
-            bank_dev,
-            jnp.asarray(np.asarray(mask_idx, np.int32)),
-            jnp.asarray(np.stack(fmask_rows)),
-            n_bins_dev, cat_dev,
-            np.float32(cfg.min_data_in_leaf),
-            np.float32(cfg.min_sum_hessian_in_leaf),
-            np.float32(cfg.lambda_l1),
-            np.float32(cfg.lambda_l2),
-            np.float32(cfg.min_gain_to_split),
-            np.float32(lr),
-            objective=objective,
-            num_bins=num_bins_static,
-            num_leaves=cfg.num_leaves,
-            depth_limit=(
-                int(cfg.max_depth) if cfg.max_depth > 0 else cfg.num_leaves
-            ),
-            max_cat_threshold=int(grow_cfg.max_cat_threshold),
-            num_class=k,
-            rf=rf_mode,
-            has_w=w_dev is not None,
-            n_bins_static=n_bins_static,
-            cat_static=cat_static,
-            hist_impl=hist_impl,
-            valid_idx=(
-                jnp.asarray(vrows.astype(np.int32)) if has_valid else None
-            ),
+        t_boost = time.perf_counter()
+        boost_span = tr.start_span(
+            "gbdt:boost_fused",
+            attrs={"iterations": cfg.num_iterations, "rows": n_orig,
+                   "features": f, "num_class": k},
         )
+        try:
+            result = boost_loop_fused(
+                bins_dev, y_dev, w_arg, raw,
+                bank_dev,
+                jnp.asarray(np.asarray(mask_idx, np.int32)),
+                jnp.asarray(np.stack(fmask_rows)),
+                n_bins_dev, cat_dev,
+                np.float32(cfg.min_data_in_leaf),
+                np.float32(cfg.min_sum_hessian_in_leaf),
+                np.float32(cfg.lambda_l1),
+                np.float32(cfg.lambda_l2),
+                np.float32(cfg.min_gain_to_split),
+                np.float32(lr),
+                objective=objective,
+                num_bins=num_bins_static,
+                num_leaves=cfg.num_leaves,
+                depth_limit=(
+                    int(cfg.max_depth) if cfg.max_depth > 0 else cfg.num_leaves
+                ),
+                max_cat_threshold=int(grow_cfg.max_cat_threshold),
+                num_class=k,
+                rf=rf_mode,
+                has_w=w_dev is not None,
+                n_bins_static=n_bins_static,
+                cat_static=cat_static,
+                hist_impl=hist_impl,
+                valid_idx=(
+                    jnp.asarray(vrows.astype(np.int32)) if has_valid else None
+                ),
+            )
+        finally:
+            # a failed fit's dominant phase must still reach the trace ring
+            # and the histogram — that run is the one being diagnosed
+            tr.end_span(boost_span)
+            phase_hist.labels(phase="boost_fused").observe(
+                time.perf_counter() - t_boost
+            )
         if has_valid:
             packs_dev, raw, vraws_dev = result
         else:
@@ -487,117 +512,128 @@ def train_booster(
             objective_params=_objective_params(objective),
         )
 
+    round_hist = obs_registry().histogram(
+        "gbdt_round_seconds",
+        "Wall seconds per boosting round (legacy per-iteration loop)",
+    )
     for it in range(start_iter, start_iter + cfg.num_iterations):
-        # -- sampling -----------------------------------------------------------
-        if use_bagging and (rf_mode or it % max(1, cfg.bagging_freq) == 0):
-            frac = cfg.bagging_fraction if cfg.bagging_fraction < 1.0 else 0.632
-            bag_mask = train_rows & (bag_draw() < frac)
-        sample_amp = None
+        t_round = time.perf_counter()
+        round_span = tr.start_span("gbdt:round", attrs={"iteration": it})
 
-        # rf: trees are independent (bagged fits to the INITIAL gradients),
-        # not boosted — gradients always taken at the init score
-        raw_for_grad = raw_init if rf_mode else raw
-        dropped: List[int] = []
-        if dart_mode and trees and rng.random() >= cfg.skip_drop:
-            n_drop = min(
-                cfg.max_drop, int(np.ceil(len(trees) * cfg.drop_rate))
-            )
-            if n_drop > 0:
-                dropped = list(
-                    rng.choice(len(trees), size=n_drop, replace=False)
+        try:
+            # -- sampling -----------------------------------------------------------
+            if use_bagging and (rf_mode or it % max(1, cfg.bagging_freq) == 0):
+                frac = cfg.bagging_fraction if cfg.bagging_fraction < 1.0 else 0.632
+                bag_mask = train_rows & (bag_draw() < frac)
+            sample_amp = None
+
+            # rf: trees are independent (bagged fits to the INITIAL gradients),
+            # not boosted — gradients always taken at the init score
+            raw_for_grad = raw_init if rf_mode else raw
+            dropped: List[int] = []
+            if dart_mode and trees and rng.random() >= cfg.skip_drop:
+                n_drop = min(
+                    cfg.max_drop, int(np.ceil(len(trees) * cfg.drop_rate))
                 )
-                raw_for_grad = raw - drop_contrib(dropped)
+                if n_drop > 0:
+                    dropped = list(
+                        rng.choice(len(trees), size=n_drop, replace=False)
+                    )
+                    raw_for_grad = raw - drop_contrib(dropped)
 
-        g_dev, h_dev = grad_fn(raw_for_grad)
+            g_dev, h_dev = grad_fn(raw_for_grad)
 
-        if goss_mode and it >= 1:
-            # Rank |gradient| over TRAIN rows only — padding (sharded runs)
-            # and validation rows must neither consume top_n/other_n slots
-            # nor inflate the fractions' denominator.
-            g_abs = np.abs(np.asarray(g_dev if k == 1 else g_dev.sum(axis=1)))
-            train_idx = np.flatnonzero(train_rows)
-            n_train = train_idx.size
-            top_n = int(cfg.top_rate * n_train)
-            other_n = int(cfg.other_rate * n_train)
-            order = train_idx[np.argsort(-g_abs[train_idx])]
-            top_idx = order[:top_n]
-            rest = order[top_n:]
-            rest_idx = rng.choice(rest, size=min(other_n, len(rest)), replace=False)
-            goss_mask = np.zeros(n, bool)
-            goss_mask[top_idx] = True
-            goss_mask[rest_idx] = True
-            bag_mask = train_rows & goss_mask
-            amp = np.ones(n, np.float32)
-            amp[rest_idx] = (1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12)
-            sample_amp = jax.device_put(amp)
+            if goss_mode and it >= 1:
+                # Rank |gradient| over TRAIN rows only — padding (sharded runs)
+                # and validation rows must neither consume top_n/other_n slots
+                # nor inflate the fractions' denominator.
+                g_abs = np.abs(np.asarray(g_dev if k == 1 else g_dev.sum(axis=1)))
+                train_idx = np.flatnonzero(train_rows)
+                n_train = train_idx.size
+                top_n = int(cfg.top_rate * n_train)
+                other_n = int(cfg.other_rate * n_train)
+                order = train_idx[np.argsort(-g_abs[train_idx])]
+                top_idx = order[:top_n]
+                rest = order[top_n:]
+                rest_idx = rng.choice(rest, size=min(other_n, len(rest)), replace=False)
+                goss_mask = np.zeros(n, bool)
+                goss_mask[top_idx] = True
+                goss_mask[rest_idx] = True
+                bag_mask = train_rows & goss_mask
+                amp = np.ones(n, np.float32)
+                amp[rest_idx] = (1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12)
+                sample_amp = jax.device_put(amp)
 
-        mask_dev = jax.device_put(bag_mask) if (use_bagging or goss_mode) else train_mask_dev
+            mask_dev = jax.device_put(bag_mask) if (use_bagging or goss_mode) else train_mask_dev
 
-        # -- grow k trees -------------------------------------------------------
-        # dart must materialize host trees immediately (drop bookkeeping
-        # rescales past trees); other modes defer the packed-buffer fetch
-        # to the end of the fit — zero per-iteration D2H.
-        new_trees: List[Any] = []
-        fmask_dev = full_fmask_dev
-        if cfg.feature_fraction < 1.0:
-            n_keep = max(1, int(np.ceil(cfg.feature_fraction * f)))
-            keep = frng.choice(f, size=n_keep, replace=False)
-            feature_mask = np.zeros(f, bool)
-            feature_mask[keep] = True
-            fmask_dev = jax.device_put(feature_mask)
+            # -- grow k trees -------------------------------------------------------
+            # dart must materialize host trees immediately (drop bookkeeping
+            # rescales past trees); other modes defer the packed-buffer fetch
+            # to the end of the fit — zero per-iteration D2H.
+            new_trees: List[Any] = []
+            fmask_dev = full_fmask_dev
+            if cfg.feature_fraction < 1.0:
+                n_keep = max(1, int(np.ceil(cfg.feature_fraction * f)))
+                keep = frng.choice(f, size=n_keep, replace=False)
+                feature_mask = np.zeros(f, bool)
+                feature_mask[keep] = True
+                fmask_dev = jax.device_put(feature_mask)
 
-        for c in range(k):
-            gc = g_dev[:, c] if k > 1 else g_dev
-            hc = h_dev[:, c] if k > 1 else h_dev
-            if sample_amp is not None:
-                gc = gc * sample_amp
-                hc = hc * sample_amp
-            packed, leaf_vals, assign = grow_tree_packed(
-                bins_dev, gc, hc, mask_dev,
-                n_bins_dev, cat_dev, fmask_dev,
-                num_bins_static, grow_cfg,
-                n_bins_static=n_bins_static,
-                cat_static=cat_static,
-                hist_impl=hist_impl,
-            )
-            if dart_mode:
-                tree = unpack_tree(
-                    np.asarray(packed), grow_cfg.num_leaves,
-                    num_bins_static, binner.threshold_value, grow_cfg,
+            for c in range(k):
+                gc = g_dev[:, c] if k > 1 else g_dev
+                hc = h_dev[:, c] if k > 1 else h_dev
+                if sample_amp is not None:
+                    gc = gc * sample_amp
+                    hc = hc * sample_amp
+                packed, leaf_vals, assign = grow_tree_packed(
+                    bins_dev, gc, hc, mask_dev,
+                    n_bins_dev, cat_dev, fmask_dev,
+                    num_bins_static, grow_cfg,
+                    n_bins_static=n_bins_static,
+                    cat_static=cat_static,
+                    hist_impl=hist_impl,
                 )
-                if dropped:
-                    norm = 1.0 / (len(dropped) + 1)
-                    tree.leaf_value = [v * norm for v in tree.leaf_value]
-                    leaf_vals = leaf_vals * np.float32(norm)
-                new_trees.append(tree)
-            else:
-                new_trees.append(_DeferredTree(packed))
-            if k > 1:
-                raw = raw.at[:, c].add(leaf_vals[assign])
-            else:
-                raw = add_leaf_outputs(raw, assign, leaf_vals)
+                if dart_mode:
+                    tree = unpack_tree(
+                        np.asarray(packed), grow_cfg.num_leaves,
+                        num_bins_static, binner.threshold_value, grow_cfg,
+                    )
+                    if dropped:
+                        norm = 1.0 / (len(dropped) + 1)
+                        tree.leaf_value = [v * norm for v in tree.leaf_value]
+                        leaf_vals = leaf_vals * np.float32(norm)
+                    new_trees.append(tree)
+                else:
+                    new_trees.append(_DeferredTree(packed))
+                if k > 1:
+                    raw = raw.at[:, c].add(leaf_vals[assign])
+                else:
+                    raw = add_leaf_outputs(raw, assign, leaf_vals)
 
-        if dart_mode and dropped:
-            # scale dropped trees down and adjust raw by the delta
-            scale = len(dropped) / (len(dropped) + 1.0)
-            delta = drop_contrib(dropped) * (scale - 1.0)
-            raw = raw + delta
-            for t in dropped:
-                trees[t].leaf_value = [v * scale for v in trees[t].leaf_value]
-                tree_contrib_cache.pop(t, None)
+            if dart_mode and dropped:
+                # scale dropped trees down and adjust raw by the delta
+                scale = len(dropped) / (len(dropped) + 1.0)
+                delta = drop_contrib(dropped) * (scale - 1.0)
+                raw = raw + delta
+                for t in dropped:
+                    trees[t].leaf_value = [v * scale for v in trees[t].leaf_value]
+                    tree_contrib_cache.pop(t, None)
 
-        trees.extend(new_trees)
+            trees.extend(new_trees)
 
-        # -- eval / early stopping ---------------------------------------------
-        if has_valid:
-            raw_np = np.asarray(raw)[:n_orig]
-            if rf_mode:  # rf scores are tree averages
-                n_trees_now = (it - start_iter + 1)
-                init_np = np.asarray(raw_init)[:n_orig]
-                raw_np = init_np + (raw_np - init_np) / n_trees_now
-            if tracker.update(raw_np[valid_mask], it):
-                trees = trees[: (tracker.best_iter + 1) * k]
-                break
+            # -- eval / early stopping ---------------------------------------------
+            if has_valid:
+                raw_np = np.asarray(raw)[:n_orig]
+                if rf_mode:  # rf scores are tree averages
+                    n_trees_now = (it - start_iter + 1)
+                    init_np = np.asarray(raw_init)[:n_orig]
+                    raw_np = init_np + (raw_np - init_np) / n_trees_now
+                if tracker.update(raw_np[valid_mask], it):
+                    trees = trees[: (tracker.best_iter + 1) * k]
+                    break
+        finally:
+            tr.end_span(round_span)
+            round_hist.observe(time.perf_counter() - t_round)
 
     trees = [
         t.materialize(grow_cfg, num_bins_static, binner.threshold_value)

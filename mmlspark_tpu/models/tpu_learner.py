@@ -245,7 +245,19 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
             new_params = optax.apply_updates(ts["params"], updates)
             return {"params": new_params, "state": new_state, "opt": new_opt}, loss
 
-        jit_step = jax.jit(step, donate_argnums=(0,))
+        # Donating the train state lets XLA update parameter buffers in
+        # place (the HBM win on real chips). On the multi-replica CPU
+        # backend (the 8-virtual-device test mesh) donation exposes a
+        # read-after-donate race: a replica's collective contribution can
+        # still be reading the donated input while its buffer is reused,
+        # corrupting gradients nondeterministically under scheduler load
+        # (loss trajectories drift 1-16% run to run; reproduced by
+        # test_loss_parity_1_vs_8_devices under concurrent CPU activity,
+        # gone with donation off). Donate only where it is race-free.
+        donate_ok = mesh.size == 1 or jax.default_backend() != "cpu"
+        jit_step = (
+            jax.jit(step, donate_argnums=(0,)) if donate_ok else jax.jit(step)
+        )
 
         losses: List[float] = []
         steps_per_epoch = -(-n // bs)  # ceil: the final partial batch is

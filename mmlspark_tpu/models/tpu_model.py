@@ -40,6 +40,24 @@ from mmlspark_tpu.parallel.mesh import batch_sharding, replicated_sharding
 from mmlspark_tpu.utils.profiling import dataplane_counters
 
 
+_DISPATCH_ROWS_HIST = []
+
+
+def _dispatch_rows_hist():
+    """Padded rows per device dispatch: the bucketing efficiency metric
+    (mean dispatch rows >> mean real rows means the bucket cap is oversized
+    for the traffic). Created once — _eval_batches runs under the serving
+    model lock and must not pay a registry lookup per batch."""
+    if not _DISPATCH_ROWS_HIST:
+        from mmlspark_tpu.obs.metrics import registry
+
+        _DISPATCH_ROWS_HIST.append(registry().histogram(
+            "tpu_model_dispatch_rows",
+            "Padded rows per TPUModel device dispatch",
+        ))
+    return _DISPATCH_ROWS_HIST[0]
+
+
 def _forward_key(net: Network, donate: bool = False):
     key = ("tpu_model.forward", str(net.spec), str(net.input_shape), net.compute_dtype)
     return key + ("donate",) if donate else key
@@ -275,6 +293,7 @@ class TPUModel(Model, Wrappable):
         cache = dispatch_cache()
         counters = dataplane_counters()
         device_in = is_device_array(x)
+        dispatch_rows = _dispatch_rows_hist()
 
         if self.get(self.use_mesh):
             from mmlspark_tpu.parallel.mesh import data_parallel_mesh
@@ -347,6 +366,7 @@ class TPUModel(Model, Wrappable):
                 fkey_donate if donate else fkey,
                 (int(padded.shape[0]),) + tuple(x.shape[1:]),
             )
+            dispatch_rows.observe(int(padded.shape[0]))
             y = (fn_donate if donate else fn)(variables, xd)
             in_flight.append(y)
             results.append((y, real))
@@ -386,6 +406,8 @@ class TPUModel(Model, Wrappable):
         return schema + [Field(self.get(self.output_col), DataType.VECTOR)]
 
     def transform(self, df: DataFrame) -> DataFrame:
+        from mmlspark_tpu.obs import tracer
+
         in_col = self.get(self.input_col)
         net = self.get_model().network
         # device-backed input columns stay on device end to end; host input
@@ -393,7 +415,11 @@ class TPUModel(Model, Wrappable):
         x = extract_feature_matrix(
             df.column(in_col), net.input_shape, in_col, prefer_device=True
         )
-        y = self._eval_batches(x)
+        with tracer().span(
+            "tpu_model:eval", rows=int(x.shape[0]),
+            batch=self.get(self.mini_batch_size),
+        ):
+            y = self._eval_batches(x)
         if self.get(self.convert_output_to_dense_vector) and y.ndim > 2:
             y = y.reshape(y.shape[0], -1)
         out_dtype = DataType.VECTOR if y.ndim == 2 else None

@@ -1,0 +1,75 @@
+"""obs — the unified observability layer.
+
+One subsystem every layer reports into, scrapeable over HTTP
+(docs/observability.md):
+
+- **Metrics** (`obs.metrics`): a process-wide `MetricsRegistry` of labelled
+  Counter/Gauge/Histogram instruments with streaming-quantile latency
+  sketches and Prometheus text exposition. The dataplane counters
+  (utils/profiling.DataplaneCounters), the serving engine's stage meters
+  (ServingPipelineCounters), pipeline/GBDT stage timings and the dispatch
+  cache all live here; `ServingServer` serves the whole registry at
+  ``GET /metrics``.
+- **Tracing** (`obs.tracing`): Dapper-style spans with ids, parent links
+  and attributes. A served request's id propagates from the HTTP edge
+  through parse -> score -> reply and into per-stage `PipelineModel`
+  spans; export as JSONL or Chrome trace_event (Perfetto) to line host
+  stages up against `profile_to`'s device traces.
+- **Liveness**: ``GET /healthz`` on a `ServingServer` reports engine thread
+  health, queue depth, in-flight batches and last-dispatch age.
+
+`set_enabled(False)` turns the whole layer off (metrics AND tracing) — the
+rollback lever the overhead smoke bench (bench.run_obs_overhead_smoke,
+BENCH_pr05.json) measures against.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+from mmlspark_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    QuantileSketch,
+    parse_prometheus,
+    registry,
+)
+from mmlspark_tpu.obs.tracing import Span, Tracer, current_span, tracer
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "QuantileSketch",
+    "parse_prometheus",
+    "registry",
+    "Span",
+    "Tracer",
+    "current_span",
+    "tracer",
+    "set_enabled",
+    "disabled",
+]
+
+
+def set_enabled(enabled: bool) -> None:
+    """Enable/disable the whole observability layer: every metric
+    instrument and every span becomes a no-op when off."""
+    registry().set_enabled(enabled)
+    tracer().set_enabled(enabled)
+
+
+@contextlib.contextmanager
+def disabled() -> Iterator[None]:
+    """Scoped full-off (the overhead bench's baseline arm)."""
+    prev = (registry().enabled, tracer().enabled)
+    set_enabled(False)
+    try:
+        yield
+    finally:
+        registry().set_enabled(prev[0])
+        tracer().set_enabled(prev[1])

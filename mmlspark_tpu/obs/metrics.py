@@ -1,0 +1,571 @@
+"""Metrics registry: labelled Counter/Gauge/Histogram with Prometheus text
+exposition.
+
+The single metrics store every layer reports into (the pull-based
+Prometheus/Monarch model): the dataplane counters, the serving engine's
+stage meters, pipeline/GBDT stage timings all register here, and
+`ServingServer` exposes the whole registry over ``GET /metrics``
+(docs/observability.md). Design constraints, in order:
+
+1. **Hot-path cheap.** `Counter.inc` / `Histogram.observe` are a lock plus
+   two float adds — they run per transfer / per request on serving hot
+   paths. Aggregation (quantiles, occupancy) happens at scrape time.
+2. **Bounded memory.** Latency distributions go through a KLL-style
+   streaming compactor (`QuantileSketch`): O(k·log n) floats regardless of
+   traffic volume, so p50/p95/p99 stay cheap forever.
+3. **Disableable.** `MetricsRegistry.set_enabled(False)` turns every
+   instrument into a no-op (the rollback lever; the overhead smoke bench
+   measures instrumented vs disabled throughput, BENCH_pr05.json).
+
+Naming follows Prometheus conventions: counters end in ``_total``, time is
+``_seconds`` or ``_ms``, label names are snake_case. Histograms render as
+Prometheus *summary* families (``{quantile="0.99"}`` + ``_count``/``_sum``)
+because the sketch gives exact-ish quantiles without fixed buckets.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "QuantileSketch",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
+    "parse_prometheus",
+]
+
+
+class QuantileSketch:
+    """Bounded-memory streaming quantiles (a KLL-style merging compactor).
+
+    Values land in a level-0 buffer of `k` floats; a full level sorts and
+    keeps every other element (weight doubles) into the level above, so n
+    observations occupy O(k·log(n/k)) floats. Rank error is O(1/k) — with
+    the default k=128 the p99 of a latency stream is exact enough to gate a
+    bench on. `quantile()` answers from one weighted sorted pass, so asking
+    for p50/p95/p99 together costs one sort of ≤ k·levels items.
+
+    Deterministic: compaction alternates keep-parity per level instead of
+    randomizing, so identical streams give identical sketches (tests can
+    assert exact behavior). Not thread-safe by itself — Histogram serializes
+    access under its child lock.
+    """
+
+    def __init__(self, k: int = 128):
+        if k < 8:
+            raise ValueError("sketch k must be >= 8")
+        self._k = int(k)
+        self._levels: List[List[float]] = [[]]
+        self._parity: List[int] = [0]
+        self.count = 0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def add(self, value: float) -> None:
+        v = float(value)
+        self.count += 1
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        self._levels[0].append(v)
+        if len(self._levels[0]) >= self._k:
+            self._compact(0)
+
+    def _compact(self, i: int) -> None:
+        lvl = sorted(self._levels[i])
+        keep = lvl[self._parity[i]:: 2]
+        self._parity[i] ^= 1
+        self._levels[i] = []
+        if i + 1 == len(self._levels):
+            self._levels.append([])
+            self._parity.append(0)
+        self._levels[i + 1].extend(keep)
+        if len(self._levels[i + 1]) >= self._k:
+            self._compact(i + 1)
+
+    def quantile(self, q: float) -> float:
+        """Value at quantile q in [0, 1]; nan when empty. Always one of the
+        retained samples, so min <= quantile(q) <= max, and monotone in q."""
+        if self.count == 0:
+            return float("nan")
+        q = min(max(float(q), 0.0), 1.0)
+        weighted: List[Tuple[float, int]] = []
+        for i, lvl in enumerate(self._levels):
+            w = 1 << i
+            weighted.extend((v, w) for v in lvl)
+        weighted.sort(key=lambda t: t[0])
+        total = sum(w for _, w in weighted)
+        target = q * total
+        cum = 0
+        for v, w in weighted:
+            cum += w
+            if cum >= target:
+                return v
+        return weighted[-1][0]
+
+    def quantiles(self, qs: Iterable[float]) -> List[float]:
+        return [self.quantile(q) for q in qs]
+
+
+def _label_key(
+    labelnames: Tuple[str, ...], labels: Dict[str, str]
+) -> Tuple[str, ...]:
+    if set(labels) != set(labelnames):
+        raise ValueError(
+            f"labels {sorted(labels)} do not match declared {sorted(labelnames)}"
+        )
+    return tuple(str(labels[n]) for n in labelnames)
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _render_labels(
+    labelnames: Tuple[str, ...], values: Tuple[str, ...],
+    extra: Optional[Tuple[str, str]] = None,
+) -> str:
+    pairs = [f'{n}="{_escape_label(v)}"' for n, v in zip(labelnames, values)]
+    if extra is not None:
+        pairs.append(f'{extra[0]}="{extra[1]}"')
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class _Family:
+    """One named metric plus its labelled children (get-or-create)."""
+
+    kind = "untyped"
+
+    def __init__(self, reg: "MetricsRegistry", name: str, help: str,
+                 labelnames: Tuple[str, ...]):
+        self._reg = reg
+        self.name = name
+        self.help = help
+        self.labelnames = labelnames
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], Any] = {}
+
+    def labels(self, **labels: str):
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child()
+                self._children[key] = child
+            return child
+
+    def remove(self, **labels: str) -> None:
+        """Drop one labelled child (and its series) from the family —
+        callback gauges closing over a torn-down object MUST be removed at
+        teardown or the registry pins the object graph forever."""
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            self._children.pop(key, None)
+
+    def _default_child(self):
+        if self.labelnames:
+            raise ValueError(
+                f"metric {self.name} declares labels {self.labelnames}; "
+                "use .labels(...)"
+            )
+        return self.labels()
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def children(self) -> List[Tuple[Tuple[str, ...], Any]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+
+class _CounterChild:
+    __slots__ = ("_fam", "_lock", "_value")
+
+    def __init__(self, fam: "Counter"):
+        self._fam = fam
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if not self._fam._reg._enabled:
+            return
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    def _make_child(self) -> _CounterChild:
+        return _CounterChild(self)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    def value(self) -> float:
+        return self._default_child().value()
+
+
+class _GaugeChild:
+    __slots__ = ("_fam", "_lock", "_value", "_fn")
+
+    def __init__(self, fam: "Gauge"):
+        self._fam = fam
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, value: float) -> None:
+        if not self._fam._reg._enabled:
+            return
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> float:
+        """Add (may be negative); returns the new value so callers can do
+        atomic read-modify chains (e.g. track a high-water mark)."""
+        with self._lock:
+            if self._fam._reg._enabled:
+                self._value += amount
+            return self._value
+
+    def dec(self, amount: float = 1.0) -> float:
+        return self.inc(-amount)
+
+    def set_max(self, candidate: float) -> None:
+        """value = max(value, candidate) — high-water marks."""
+        if not self._fam._reg._enabled:
+            return
+        with self._lock:
+            if candidate > self._value:
+                self._value = float(candidate)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        """Collect-time callback: the gauge reads `fn()` at scrape instead
+        of a stored value (queue depths, occupancy ratios)."""
+        with self._lock:
+            self._fn = fn
+
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return float(fn())
+        except Exception as e:
+            # a dead callback must not kill the whole scrape; surface it as
+            # NaN and log once at debug
+            _log().debug("gauge callback failed: %r", e)
+            return float("nan")
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def _make_child(self) -> _GaugeChild:
+        return _GaugeChild(self)
+
+    def set(self, value: float) -> None:
+        self._default_child().set(value)
+
+    def inc(self, amount: float = 1.0) -> float:
+        return self._default_child().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> float:
+        return self._default_child().dec(amount)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        self._default_child().set_function(fn)
+
+    def value(self) -> float:
+        return self._default_child().value()
+
+
+class _HistogramChild:
+    __slots__ = ("_fam", "_lock", "_sketch", "_sum")
+
+    def __init__(self, fam: "Histogram"):
+        self._fam = fam
+        self._lock = threading.Lock()
+        self._sketch = QuantileSketch(fam.sketch_k)
+        self._sum = 0.0
+
+    def observe(self, value: float) -> None:
+        if not self._fam._reg._enabled:
+            return
+        with self._lock:
+            self._sketch.add(value)
+            self._sum += value
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            return self._sketch.quantile(q)
+
+    def count(self) -> int:
+        with self._lock:
+            return self._sketch.count
+
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out = {
+                "count": float(self._sketch.count),
+                "sum": self._sum,
+                "min": self._sketch.min,
+                "max": self._sketch.max,
+            }
+            for q in self._fam.quantiles:
+                out[f"q{q}"] = self._sketch.quantile(q)
+            return out
+
+
+class Histogram(_Family):
+    """Streaming-quantile histogram; renders as a Prometheus summary."""
+
+    kind = "summary"
+
+    def __init__(self, reg, name, help, labelnames,
+                 quantiles: Tuple[float, ...] = (0.5, 0.95, 0.99),
+                 sketch_k: int = 128):
+        super().__init__(reg, name, help, labelnames)
+        self.quantiles = tuple(quantiles)
+        self.sketch_k = sketch_k
+
+    def _make_child(self) -> _HistogramChild:
+        return _HistogramChild(self)
+
+    def observe(self, value: float) -> None:
+        self._default_child().observe(value)
+
+    def quantile(self, q: float) -> float:
+        return self._default_child().quantile(q)
+
+    def count(self) -> int:
+        return self._default_child().count()
+
+    def sum(self) -> float:
+        return self._default_child().sum()
+
+
+def _log():
+    from mmlspark_tpu.core.config import get_logger
+
+    return get_logger("mmlspark_tpu.obs")
+
+
+def _format_value(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class MetricsRegistry:
+    """Named, typed metric families; one instance per scrape surface.
+
+    `registry()` returns the process-wide default every subsystem reports
+    into and `/metrics` renders. Get-or-create semantics: asking for an
+    existing name returns the existing family (type/labels must match —
+    a mismatch is a programming error and raises).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+        self._enabled = True
+
+    # -- enable/disable (the overhead rollback lever) -------------------------
+
+    def set_enabled(self, enabled: bool) -> None:
+        self._enabled = bool(enabled)
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # -- instrument constructors ----------------------------------------------
+
+    def _family(self, cls, name: str, help: str,
+                labelnames: Iterable[str], **kwargs) -> _Family:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if type(fam) is not cls or fam.labelnames != labelnames:
+                    raise ValueError(
+                        f"metric {name!r} re-registered as {cls.kind} with "
+                        f"labels {labelnames}, but exists as {fam.kind} with "
+                        f"{fam.labelnames}"
+                    )
+                # kwargs (histogram quantiles/sketch_k) must match too — a
+                # silent mismatch would drop the second caller's series
+                mismatched = {
+                    k: v for k, v in kwargs.items()
+                    if getattr(fam, k, v) != v
+                }
+                if mismatched:
+                    raise ValueError(
+                        f"metric {name!r} re-registered with {mismatched}, "
+                        "but the existing family differs"
+                    )
+                return fam
+            fam = cls(self, name, help, labelnames, **kwargs)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Iterable[str] = ()) -> Counter:
+        return self._family(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Iterable[str] = ()) -> Gauge:
+        return self._family(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Iterable[str] = (),
+                  quantiles: Tuple[float, ...] = (0.5, 0.95, 0.99),
+                  sketch_k: int = 128) -> Histogram:
+        return self._family(Histogram, name, help, labelnames,
+                            quantiles=quantiles, sketch_k=sketch_k)
+
+    def families(self) -> List[_Family]:
+        with self._lock:
+            return [self._families[n] for n in sorted(self._families)]
+
+    # -- exposition -----------------------------------------------------------
+
+    def render_prometheus(self) -> str:
+        """The registry in Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        for fam in self.families():
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for key, child in fam.children():
+                if isinstance(child, _HistogramChild):
+                    snap = child.snapshot()
+                    for q in fam.quantiles:
+                        lines.append(
+                            fam.name
+                            + _render_labels(fam.labelnames, key,
+                                             extra=("quantile", str(q)))
+                            + f" {_format_value(snap[f'q{q}'])}"
+                        )
+                    base = _render_labels(fam.labelnames, key)
+                    lines.append(f"{fam.name}_count{base} "
+                                 f"{_format_value(snap['count'])}")
+                    lines.append(f"{fam.name}_sum{base} "
+                                 f"{_format_value(snap['sum'])}")
+                else:
+                    lines.append(
+                        fam.name + _render_labels(fam.labelnames, key)
+                        + f" {_format_value(child.value())}"
+                    )
+        return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]:
+    """Parse Prometheus text exposition into {(name, ((label, value), ...)):
+    value}. Covers the subset `render_prometheus` emits (and standard
+    Prometheus output for it) — the scrape-parses gate in
+    tests/test_bench_smoke.py uses this, so 'it renders' and 'it parses'
+    are the same check."""
+    out: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            labelblob, _, valpart = rest.rpartition("}")
+            labels = []
+            for item in _split_labels(labelblob):
+                if not item:
+                    continue
+                k, _, v = item.partition("=")
+                v = v.strip()
+                if not (v.startswith('"') and v.endswith('"')):
+                    raise ValueError(f"unquoted label value in line: {raw!r}")
+                labels.append((k.strip(), _unescape_label(v[1:-1])))
+            value = valpart.strip().split()[0]
+        else:
+            parts = line.split()
+            if len(parts) < 2:
+                raise ValueError(f"unparseable metric line: {raw!r}")
+            name, value = parts[0], parts[1]
+            labels = []
+        out[(name.strip(), tuple(sorted(labels)))] = float(value)
+    return out
+
+
+def _unescape_label(s: str) -> str:
+    """Left-to-right unescape of a label value (inverse of _escape_label).
+    Ordered str.replace would corrupt values holding literal backslash
+    sequences — '\\\\n' must decode to backslash+n, not newline."""
+    out: List[str] = []
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt in ('"', "\\"):
+                out.append(nxt)
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _split_labels(blob: str) -> List[str]:
+    """Split a label block on commas outside quotes."""
+    items, cur, in_q, escaped = [], [], False, False
+    for ch in blob:
+        if escaped:
+            cur.append(ch)
+            escaped = False
+        elif ch == "\\":
+            cur.append(ch)
+            escaped = True
+        elif ch == '"':
+            cur.append(ch)
+            in_q = not in_q
+        elif ch == "," and not in_q:
+            items.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    items.append("".join(cur).strip())
+    return items
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide default registry (`/metrics` renders this one)."""
+    return _REGISTRY

@@ -1,0 +1,334 @@
+"""Request tracing: spans with ids, parent links and attributes.
+
+The Dapper span model, sized for an in-process serving stack: a request
+gets a root span at the HTTP edge (`ServingServer`), every stage it crosses
+(parse -> score -> reply, then each `PipelineModel` stage) attaches a child
+span, and the finished tree is exportable two ways:
+
+- **JSONL** (`export_jsonl`): one span per line — greppable, diffable,
+  loadable into anything.
+- **Chrome trace_event** (`export_chrome_trace`): ``{"traceEvents": [...]}``
+  with complete ("X") events — load it in Perfetto / chrome://tracing next
+  to `profile_to`'s device traces to line host stages up against device
+  activity.
+
+Span timing uses `time.monotonic()` (durations must survive clock steps);
+export converts to epoch timestamps through a wall-clock anchor captured
+once at import. The tracer keeps a bounded ring of finished spans
+(default 8192) so always-on tracing has O(1) memory; `set_enabled(False)`
+makes every span a shared no-op object (the overhead lever, mirrored with
+the metrics registry by `obs.set_enabled`).
+
+Cross-thread propagation is explicit: the serving engine hands the request
+span along in its work items and re-`activate()`s it in the worker thread.
+Within a thread, `tracer().span(...)` nests under the currently active span
+automatically (contextvars).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional
+
+__all__ = ["Span", "Tracer", "tracer", "current_span"]
+
+# wall-clock anchor for export: spans time with monotonic, export maps to
+# epoch as anchor_wall + (t - anchor_mono). time.time() is used ONLY as the
+# fixed anchor, never differenced against another reading.
+_ANCHOR_WALL = time.time()
+_ANCHOR_MONO = time.monotonic()
+
+
+def _epoch(t_mono: float) -> float:
+    return _ANCHOR_WALL + (t_mono - _ANCHOR_MONO)
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class Span:
+    """One timed, attributed operation. Mutable until `end()`; safe to hand
+    across threads (attribute writes are GIL-atomic dict stores)."""
+
+    __slots__ = (
+        "trace_id", "span_id", "parent_id", "name", "attrs", "events",
+        "t_start", "t_end", "thread",
+    )
+
+    def __init__(self, name: str, trace_id: Optional[str] = None,
+                 parent_id: Optional[str] = None,
+                 attrs: Optional[Dict[str, Any]] = None,
+                 t_start: Optional[float] = None):
+        self.name = name
+        self.trace_id = trace_id or _new_id()
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.attrs: Dict[str, Any] = dict(attrs) if attrs else {}
+        self.events: List[Dict[str, Any]] = []
+        self.t_start = time.monotonic() if t_start is None else t_start
+        self.t_end: Optional[float] = None
+        self.thread = threading.get_ident()
+
+    @property
+    def recording(self) -> bool:
+        return True
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def add_event(self, name: str, **attrs: Any) -> None:
+        """Point-in-time annotation inside the span (e.g. a d2h sync)."""
+        self.events.append(
+            {"name": name, "t": time.monotonic(), "attrs": attrs}
+        )
+
+    def duration_ms(self) -> float:
+        end = self.t_end if self.t_end is not None else time.monotonic()
+        return (end - self.t_start) * 1e3
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start_ts": round(_epoch(self.t_start), 6),
+            "duration_ms": round(self.duration_ms(), 3),
+            "attrs": self.attrs,
+            "events": [
+                {
+                    "name": e["name"],
+                    "ts": round(_epoch(e["t"]), 6),
+                    "attrs": e["attrs"],
+                }
+                for e in self.events
+            ],
+            "thread": self.thread,
+        }
+
+    def __repr__(self) -> str:
+        return (f"Span({self.name!r}, trace={self.trace_id}, "
+                f"span={self.span_id}, parent={self.parent_id})")
+
+
+class _NoopSpan:
+    """Shared do-nothing span returned while tracing is disabled."""
+
+    trace_id = span_id = parent_id = None
+    name = "noop"
+    attrs: Dict[str, Any] = {}
+    events: List[Dict[str, Any]] = []
+    t_start = 0.0
+    t_end = 0.0
+    thread = 0
+
+    @property
+    def recording(self) -> bool:
+        return False
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+    def add_event(self, name: str, **attrs: Any) -> None:
+        pass
+
+    def duration_ms(self) -> float:
+        return 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {}
+
+
+_NOOP = _NoopSpan()
+
+_CURRENT: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "mmlspark_tpu_current_span", default=None
+)
+
+
+def current_span() -> Optional[Span]:
+    """The span active in this thread/context, or None."""
+    return _CURRENT.get()
+
+
+class Tracer:
+    """Creates spans, tracks the active one per thread, retains finished
+    spans in a bounded ring for export."""
+
+    def __init__(self, max_spans: int = 8192):
+        self._lock = threading.Lock()
+        self._finished: "deque[Span]" = deque(maxlen=max_spans)
+        self._enabled = True
+
+    # -- enable/disable --------------------------------------------------------
+
+    def set_enabled(self, enabled: bool) -> None:
+        self._enabled = bool(enabled)
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # -- span lifecycle --------------------------------------------------------
+
+    def start_span(self, name: str, parent: Optional[Span] = None,
+                   attrs: Optional[Dict[str, Any]] = None) -> Span:
+        """Begin a span. `parent=None` nests under the context's current
+        span when there is one; pass an explicit parent to propagate across
+        threads (the serving engine's path)."""
+        if not self._enabled:
+            return _NOOP
+        if parent is None:
+            parent = _CURRENT.get()
+        if parent is not None and parent.recording:
+            return Span(name, trace_id=parent.trace_id,
+                        parent_id=parent.span_id, attrs=attrs)
+        return Span(name, attrs=attrs)
+
+    def end_span(self, span: Span, t_end: Optional[float] = None) -> None:
+        if not span.recording:
+            return
+        if span.t_end is None:
+            span.t_end = time.monotonic() if t_end is None else t_end
+        with self._lock:
+            self._finished.append(span)
+
+    def add_span(self, name: str, parent: Optional[Span],
+                 t_start: float, t_end: float,
+                 attrs: Optional[Dict[str, Any]] = None) -> Span:
+        """Record an already-timed operation retroactively — batch stages
+        attach one of these per request after timing the batch once."""
+        if not self._enabled or (parent is not None and not parent.recording):
+            return _NOOP
+        span = Span(
+            name,
+            trace_id=parent.trace_id if parent is not None else None,
+            parent_id=parent.span_id if parent is not None else None,
+            attrs=attrs, t_start=t_start,
+        )
+        self.end_span(span, t_end=t_end)
+        return span
+
+    @contextlib.contextmanager
+    def activate(self, span: Span) -> Iterator[Span]:
+        """Make `span` the context's current span (so nested tracer.span
+        calls parent to it) without ending it on exit."""
+        if not span.recording:
+            yield span
+            return
+        token = _CURRENT.set(span)
+        try:
+            yield span
+        finally:
+            _CURRENT.reset(token)
+
+    @contextlib.contextmanager
+    def span(self, name: str, parent: Optional[Span] = None,
+             **attrs: Any) -> Iterator[Span]:
+        """Context manager: start, activate, end. Exceptions mark the span
+        (`error` attr) and propagate."""
+        span = self.start_span(name, parent=parent, attrs=attrs or None)
+        if not span.recording:
+            yield span
+            return
+        token = _CURRENT.set(span)
+        try:
+            yield span
+        except BaseException as e:
+            span.set_attribute("error", repr(e))
+            raise
+        finally:
+            _CURRENT.reset(token)
+            self.end_span(span)
+
+    # -- inspection / export ---------------------------------------------------
+
+    def spans(self, trace_id: Optional[str] = None) -> List[Span]:
+        """Finished spans (oldest first), optionally one trace's."""
+        with self._lock:
+            out = list(self._finished)
+        if trace_id is not None:
+            out = [s for s in out if s.trace_id == trace_id]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._finished.clear()
+
+    def trace_summary(self, trace_id: str) -> str:
+        """'http 12.3ms -> parse 1.1ms -> score 8.0ms -> reply 0.9ms' —
+        the slow-request log line (children in start order)."""
+        spans = sorted(self.spans(trace_id), key=lambda s: s.t_start)
+        return " -> ".join(f"{s.name} {s.duration_ms():.1f}ms" for s in spans)
+
+    def to_jsonl(self, trace_id: Optional[str] = None) -> str:
+        return "".join(
+            json.dumps(s.to_dict(), sort_keys=True) + "\n"
+            for s in self.spans(trace_id)
+        )
+
+    def export_jsonl(self, path: str, trace_id: Optional[str] = None) -> int:
+        """Write spans as JSON Lines; returns the span count."""
+        spans = self.spans(trace_id)
+        with open(path, "w", encoding="utf-8") as f:
+            for s in spans:
+                f.write(json.dumps(s.to_dict(), sort_keys=True) + "\n")
+        return len(spans)
+
+    def chrome_trace(self, trace_id: Optional[str] = None) -> Dict[str, Any]:
+        """Chrome trace_event JSON (Perfetto / chrome://tracing loadable):
+        complete ("X") events per span, instant ("i") events per span event."""
+        pid = os.getpid()
+        events: List[Dict[str, Any]] = []
+        for s in self.spans(trace_id):
+            args = dict(s.attrs)
+            args["trace_id"] = s.trace_id
+            args["span_id"] = s.span_id
+            if s.parent_id:
+                args["parent_id"] = s.parent_id
+            events.append({
+                "name": s.name,
+                "cat": "mmlspark_tpu",
+                "ph": "X",
+                "ts": round(_epoch(s.t_start) * 1e6, 1),
+                "dur": round(s.duration_ms() * 1e3, 1),
+                "pid": pid,
+                "tid": s.thread,
+                "args": args,
+            })
+            for e in s.events:
+                events.append({
+                    "name": e["name"],
+                    "cat": "mmlspark_tpu.event",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": round(_epoch(e["t"]) * 1e6, 1),
+                    "pid": pid,
+                    "tid": s.thread,
+                    "args": dict(e["attrs"]),
+                })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str,
+                            trace_id: Optional[str] = None) -> int:
+        """Write the Chrome trace_event file; returns the event count."""
+        trace = self.chrome_trace(trace_id)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+        return len(trace["traceEvents"])
+
+
+_TRACER = Tracer()
+
+
+def tracer() -> Tracer:
+    """The process-wide tracer every layer reports spans into."""
+    return _TRACER

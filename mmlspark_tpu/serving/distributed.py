@@ -22,12 +22,14 @@ from __future__ import annotations
 import http.client
 import http.server
 import itertools
+import json
 import socket
 import threading
 from typing import Callable, List, Optional
 
 from mmlspark_tpu.core.config import get_logger
 from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.obs import registry as obs_registry
 from mmlspark_tpu.serving.server import ServingServer
 
 log = get_logger("mmlspark_tpu.serving")
@@ -133,8 +135,45 @@ class DistributedServingServer:
             def log_message(self, fmt, *args):
                 log.debug("gateway %s " + fmt, self.address_string(), *args)
 
+            def _send_body(self, code: int, reason: str, payload: bytes,
+                           content_type: str) -> None:
+                self.send_response(code, reason)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
             def do_POST(self):
                 route = self.path.split("?", 1)[0].rstrip("/")
+                # observability surfaces: workers share this process, so
+                # the gateway serves the shared registry directly and
+                # aggregates per-worker liveness (docs/observability.md)
+                if route in ("/metrics", "/healthz"):
+                    # drain any body first: on a keep-alive connection
+                    # unread bytes would corrupt the next request
+                    n = int(self.headers.get("Content-Length") or 0)
+                    if n:
+                        self.rfile.read(n)
+                if route == "/metrics":
+                    self._send_body(
+                        200, "OK",
+                        obs_registry().render_prometheus().encode("utf-8"),
+                        "text/plain; version=0.0.4",
+                    )
+                    return
+                if route == "/healthz":
+                    healths = [w.health() for w in outer.workers]
+                    ok = all(h[0] for h in healths)
+                    body = json.dumps({
+                        "status": "ok" if ok else "degraded",
+                        "workers": [h[1] for h in healths],
+                    }, sort_keys=True).encode("utf-8")
+                    self._send_body(
+                        200 if ok else 503,
+                        "OK" if ok else "Service Unavailable",
+                        body, "application/json",
+                    )
+                    return
                 if route != f"/{outer.api_name}":
                     self.send_response(404, "Not Found")
                     self.send_header("Content-Length", "0")

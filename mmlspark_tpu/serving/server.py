@@ -38,19 +38,36 @@ while earlier batches are in flight. Plain-callable handlers keep working:
 they run whole inside the score stage (the pre-pipeline contract);
 `engine="sync"` restores the fully synchronous engine (the rollback lever
 and the bench.py --smoke baseline).
+
+Observability (docs/observability.md): every request gets a root "http"
+span whose id follows it through parse -> score -> reply (and, via span
+context, into PipelineModel per-stage spans); request latency lands in the
+`serving_request_latency_ms` histogram. Two built-in routes serve the
+whole observability layer over HTTP on every server:
+
+- ``GET /metrics`` — the process metrics registry in Prometheus text
+  format (dataplane transfer/compile counters, per-stage occupancy,
+  latency quantiles);
+- ``GET /healthz`` — engine liveness JSON (threads alive, queue depth,
+  in-flight batches, last-dispatch age); 200 while healthy, 503 while
+  stopping or with a dead engine thread.
+
+`slow_request_ms` logs the full span path of any request slower than the
+threshold, so tail outliers arrive pre-attributed.
 """
 
 from __future__ import annotations
 
 import contextlib
 import http.server
+import itertools
 import json
 import queue
 import threading
 import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -65,12 +82,18 @@ from mmlspark_tpu.io.http.schema import (
     RequestLineData,
     StatusLineData,
 )
+from mmlspark_tpu.obs import registry as obs_registry
+from mmlspark_tpu.obs import tracer as obs_tracer
 from mmlspark_tpu.utils.profiling import (
     ServingPipelineCounters,
     dataplane_counters,
 )
 
 log = get_logger("mmlspark_tpu.serving")
+
+#: per-process server sequence — the `engine` metric label must be unique
+#: per ServingServer instance so two servers never merge their series
+_SERVER_SEQ = itertools.count()
 
 #: Object column parse_request adds when some rows fail schema conversion:
 #: None for clean rows, an error string for malformed ones. make_reply turns
@@ -349,15 +372,19 @@ class _Exchange:
     """One held HTTP exchange awaiting its reply (the reference keeps the
     com.sun HttpExchange open in MultiChannelMap / the partition reader).
     `deadline` (micro-batch only) is when the waiting client gives up and
-    sends its own 504 — replies after it are counted, not routed."""
+    sends its own 504 — replies after it are counted, not routed. `rid` is
+    the request id and `span` the root "http" trace span that follows the
+    request through every stage (obs/tracing.py)."""
 
-    __slots__ = ("request", "event", "response", "deadline")
+    __slots__ = ("request", "event", "response", "deadline", "rid", "span")
 
     def __init__(self, request: HTTPRequestData, deadline: Optional[float] = None):
         self.request = request
         self.event = threading.Event()
         self.response: Optional[HTTPResponseData] = None
         self.deadline = deadline
+        self.rid: Optional[str] = None
+        self.span: Any = None
 
     def respond(self, response: HTTPResponseData) -> None:
         self.response = response
@@ -408,6 +435,7 @@ class ServingServer:
         parse_workers: int = 2,
         reply_workers: int = 2,
         guard_score: bool = False,
+        slow_request_ms: Optional[float] = None,
     ):
         if mode not in ("continuous", "micro_batch"):
             raise ValueError("mode must be 'continuous' or 'micro_batch'")
@@ -446,7 +474,31 @@ class ServingServer:
         # ring writers are concurrent now (reply-pool workers, per-request
         # continuous handler threads), unlike the old single engine thread
         self._stage_lock = threading.Lock()
-        self._pipe_counters = ServingPipelineCounters()
+        # observability wiring: a stable per-instance label keys every
+        # registry series; the latency histogram and queue-depth gauge are
+        # the scrape-side view of what stage_summary() reports in-process
+        self.slow_request_ms = slow_request_ms
+        self._obs_label = f"{api_name}-{next(_SERVER_SEQ)}"
+        self._tracer = obs_tracer()
+        reg = obs_registry()
+        self._lat_hist = reg.histogram(
+            "serving_request_latency_ms",
+            "End-to-end request latency at the HTTP edge",
+            ("engine", "code"),
+        )
+        self._queue_gauge = reg.gauge(
+            "serving_queue_depth",
+            "Requests queued awaiting batch dispatch",
+            ("engine",),
+        )
+        self._queue_gauge.labels(engine=self._obs_label).set_function(
+            lambda: float(len(self._queue))
+        )
+        self._pipe_counters = ServingPipelineCounters(
+            engine_label=self._obs_label
+        )
+        self._last_dispatch: Optional[float] = None
+        self._t_started: Optional[float] = None
         # batches dispatched but not yet THROUGH the score stage — the
         # adaptive coalescer's "in flight" signal: while this is > 0 the
         # score stage has work coming, so waiting to fatten the next batch
@@ -522,22 +574,63 @@ class ServingServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _drain_body(self) -> None:
+                """Consume any request body before replying: HTTP/1.1
+                keep-alive means unread body bytes would be parsed as the
+                NEXT request line, corrupting the connection."""
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    self.rfile.read(length)
+
             def do_POST(self):
                 route = self.path.split("?", 1)[0].rstrip("/")
+                # observability surfaces answer on every server (verb
+                # agnostic so `curl` and scrapers both just work)
+                if route == "/metrics":
+                    self._drain_body()
+                    body = obs_registry().render_prometheus().encode("utf-8")
+                    self._send(
+                        HTTPResponseData.ok(body, "text/plain; version=0.0.4")
+                    )
+                    return
+                if route == "/healthz":
+                    self._drain_body()
+                    ok, info = outer.health()
+                    body = json.dumps(info, sort_keys=True).encode("utf-8")
+                    self._send(
+                        HTTPResponseData.ok(body)
+                        if ok
+                        else _status(503, "Service Unavailable", body)
+                    )
+                    return
                 if route != f"/{outer.api_name}":
                     self._send(_status(404, "Not Found"))
                     return
                 if outer._stopping.is_set():
                     self._send(_status(503, "Service Unavailable"))
                     return
+                t_http = time.monotonic()
+                rid = str(uuid.uuid4())
                 if outer.mode == "continuous":
                     exchange = _Exchange(self._read_request())
+                    exchange.rid = rid
+                    exchange.span = outer._tracer.start_span(
+                        "http",
+                        attrs={"request_id": rid, "path": self.path,
+                               "method": self.command, "mode": outer.mode},
+                    )
                     outer._score_now(exchange)
                 else:
                     t_enq = time.monotonic()
                     exchange = _Exchange(
                         self._read_request(),
                         deadline=t_enq + outer.request_timeout,
+                    )
+                    exchange.rid = rid
+                    exchange.span = outer._tracer.start_span(
+                        "http",
+                        attrs={"request_id": rid, "path": self.path,
+                               "method": self.command, "mode": outer.mode},
                     )
                     with outer._queue_lock:
                         # authoritative stop check: stop() sets _stopping
@@ -546,21 +639,23 @@ class ServingServer:
                         # flag here — never strands in a dead queue
                         stopped = outer._stopping.is_set()
                         if not stopped:
-                            outer._queue.append(
-                                (str(uuid.uuid4()), exchange, t_enq)
-                            )
+                            outer._queue.append((rid, exchange, t_enq))
                             outer._queue_lock.notify_all()
                     if stopped:
-                        self._send(_status(503, "Service Unavailable"))
+                        resp = _status(503, "Service Unavailable")
+                        outer._finish_http(exchange, resp, t_http)
+                        self._send(resp)
                         return
                 if not exchange.event.wait(outer.request_timeout):
-                    self._send(_status(504, "Gateway Timeout"))
-                    return
-                # a reply skipped as expired sets the event with no
-                # response; if this thread's own timer hasn't quite lapsed
-                # (clock skew vs the engine's deadline), 504 is still the
-                # truthful answer
-                self._send(exchange.response or _status(504, "Gateway Timeout"))
+                    resp = _status(504, "Gateway Timeout")
+                else:
+                    # a reply skipped as expired sets the event with no
+                    # response; if this thread's own timer hasn't quite
+                    # lapsed (clock skew vs the engine's deadline), 504 is
+                    # still the truthful answer
+                    resp = exchange.response or _status(504, "Gateway Timeout")
+                outer._finish_http(exchange, resp, t_http)
+                self._send(resp)
 
             do_GET = do_POST
             do_PUT = do_POST
@@ -570,6 +665,7 @@ class ServingServer:
         )
         self._httpd.daemon_threads = True
         self._port = self._httpd.server_address[1]
+        self._t_started = time.monotonic()
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
         if self.mode == "micro_batch":
             if self.engine == "pipelined":
@@ -635,6 +731,11 @@ class ServingServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        # unhook scrape-time callbacks that close over this server — the
+        # process registry must not pin stopped servers (or report stale
+        # liveness for them); cumulative counter series stay
+        self._queue_gauge.remove(engine=self._obs_label)
+        self._pipe_counters.close()
 
     def __enter__(self) -> "ServingServer":
         return self.start()
@@ -694,8 +795,9 @@ class ServingServer:
             # guard_score applies here too (sync engine / continuous mode):
             # the whole handler IS the critical section on these paths, so
             # the guard truthfully reports any transfer made under the lock
-            with self._score_guard():
-                out = self.handler(df)
+            with self._stage_span("score", exchanges, batch_size=len(ids)):
+                with self._score_guard():
+                    out = self.handler(df)
             self._route_replies(out, by_id, enforce_deadline)
         except Exception as e:  # surface pipeline errors as 500s, keep serving
             log.exception("handler failed")
@@ -762,13 +864,114 @@ class ServingServer:
         (utils/profiling.ServingPipelineCounters)."""
         return self._pipe_counters.summary()
 
+    # - observability ---------------------------------------------------------
+
+    def health(self) -> Tuple[bool, Dict[str, Any]]:
+        """Engine liveness: (healthy, info). Healthy means the server is
+        accepting work AND every engine thread it needs is alive; the info
+        dict is what ``GET /healthz`` returns (200 when healthy, 503
+        otherwise)."""
+        now = time.monotonic()
+        with self._queue_lock:
+            depth = len(self._queue)
+        threads: Dict[str, bool] = {}
+        if self.mode == "micro_batch":
+            if self.engine == "pipelined":
+                threads["dispatch"] = (
+                    self._dispatch_thread is not None
+                    and self._dispatch_thread.is_alive()
+                )
+                threads["score"] = (
+                    self._score_thread is not None
+                    and self._score_thread.is_alive()
+                )
+            else:
+                threads["engine"] = (
+                    self._engine_thread is not None
+                    and self._engine_thread.is_alive()
+                )
+        stopping = self._stopping.is_set()
+        started = self._httpd is not None
+        ok = started and not stopping and all(threads.values())
+        info: Dict[str, Any] = {
+            "status": "ok" if ok else ("stopping" if stopping else "degraded"),
+            "mode": self.mode,
+            "engine": self.engine,
+            "engine_label": self._obs_label,
+            "threads": threads,
+            "queue_depth": depth,
+            "in_flight": self._pipe_counters.in_flight,
+            "last_dispatch_age_s": (
+                round(now - self._last_dispatch, 3)
+                if self._last_dispatch is not None
+                else None
+            ),
+            "uptime_s": (
+                round(now - self._t_started, 3)
+                if self._t_started is not None
+                else None
+            ),
+        }
+        return ok, info
+
+    def _finish_http(self, ex: _Exchange, resp: HTTPResponseData,
+                     t0: float) -> None:
+        """Close out a request at the HTTP edge: end its root span, record
+        end-to-end latency, and log the span path when it crossed
+        `slow_request_ms`."""
+        code = resp.status_line.status_code
+        dt_ms = (time.monotonic() - t0) * 1e3
+        self._lat_hist.labels(engine=self._obs_label, code=str(code)).observe(
+            dt_ms
+        )
+        span = ex.span
+        traced = span is not None and span.recording
+        if traced:
+            span.set_attribute("status_code", code)
+            self._tracer.end_span(span)
+        if self.slow_request_ms is not None and dt_ms >= self.slow_request_ms:
+            path = (
+                self._tracer.trace_summary(span.trace_id) if traced else "untraced"
+            )
+            log.warning(
+                "slow request %s: %.1f ms (threshold %.0f ms): %s",
+                ex.rid, dt_ms, self.slow_request_ms, path,
+            )
+
+    @contextlib.contextmanager
+    def _stage_span(self, name: str, exchanges: List[_Exchange], **attrs):
+        """Trace one batch stage: a LIVE child span under the first traced
+        request (activated, so nested spans and transfer events attach to
+        it), plus a retroactive copy under every other request in the batch
+        — each request's trace ends up with its full http -> parse -> score
+        -> reply path."""
+        tr = self._tracer
+        traced = [
+            ex.span for ex in exchanges
+            if ex.span is not None and ex.span.recording
+        ]
+        if not traced:
+            yield None
+            return
+        lead, rest = traced[0], traced[1:]
+        span = tr.start_span(name, parent=lead, attrs=attrs)
+        try:
+            with tr.activate(span):
+                yield span
+        finally:
+            tr.end_span(span)
+            for parent in rest:
+                tr.add_span(name, parent, span.t_start, span.t_end,
+                            attrs=dict(span.attrs))
+
     def _score_now(self, exchange: _Exchange) -> None:
         counters = dataplane_counters()
         t0 = time.monotonic()
         with self._model_lock:
             t_locked = time.monotonic()
+            self._last_dispatch = t_locked
             dp_before = counters.snapshot()
-            self._run_batch([str(uuid.uuid4())], [exchange])
+            self._run_batch([exchange.rid or str(uuid.uuid4())], [exchange])
             dp = counters.delta(dp_before)
         t_done = time.monotonic()
         # continuous mode records the same decomposition as micro-batch so
@@ -812,6 +1015,7 @@ class ServingServer:
                 ids = [rid for rid, _, _t in batch]
                 exchanges = [ex for _, ex, _t in batch]
                 t_assembled = time.monotonic()
+                self._last_dispatch = t_assembled
                 with self._model_lock:
                     t_locked = time.monotonic()
                     dp_before = counters.snapshot()
@@ -882,6 +1086,7 @@ class ServingServer:
             self._pipe_counters.enter_in_flight()
             self._pipe_counters.record_dispatch(immediate)
             t_dispatch = time.monotonic()
+            self._last_dispatch = t_dispatch
             try:
                 self._parse_pool.submit(self._parse_batch, batch, t_dispatch)
             except RuntimeError:  # pool torn down mid-stop
@@ -906,9 +1111,14 @@ class ServingServer:
         try:
             t0 = time.monotonic()
             with self._pipe_counters.stage("parse", rows=len(batch)):
-                dp_before = counters.snapshot()
-                parsed = self._staged.parse(_request_frame(ids, exchanges))
-                h2d = counters.delta(dp_before)["h2d_transfers"]
+                with self._stage_span(
+                    "parse", exchanges, batch_size=len(batch)
+                ) as pspan:
+                    dp_before = counters.snapshot()
+                    parsed = self._staged.parse(_request_frame(ids, exchanges))
+                    h2d = counters.delta(dp_before)["h2d_transfers"]
+                    if pspan is not None:
+                        pspan.set_attribute("h2d_transfers", h2d)
             self._score_q.put(
                 {
                     "batch": batch,
@@ -958,11 +1168,16 @@ class ServingServer:
                 t_locked = time.monotonic()
                 try:
                     with self._pipe_counters.stage("score"):
-                        with self._score_guard():
-                            # JAX async dispatch: returns once the batch is
-                            # QUEUED on the device, so the next batch's parse
-                            # and this one's compute overlap
-                            scored = self._staged.score(work["parsed"])
+                        with self._stage_span(
+                            "score", work["exchanges"],
+                            batch_size=len(work["batch"]),
+                        ):
+                            with self._score_guard():
+                                # JAX async dispatch: returns once the batch
+                                # is QUEUED on the device, so the next
+                                # batch's parse and this one's compute
+                                # overlap
+                                scored = self._staged.score(work["parsed"])
                 except Exception as e:
                     log.exception("score stage failed")
                     err = _status(
@@ -1002,14 +1217,22 @@ class ServingServer:
                     self._respond_engine(ex, _status(504, "Gateway Timeout"))
                 return
             with self._pipe_counters.stage("reply"):
-                dp_before = counters.snapshot()
-                out = self._staged.reply(work["scored"])
+                # the reply span closes BEFORE replies are routed: routing
+                # wakes the HTTP threads, which log the slow-request span
+                # path — every stage span must already be in the ring
+                with self._stage_span(
+                    "reply", work["exchanges"], batch_size=len(work["batch"])
+                ) as rspan:
+                    dp_before = counters.snapshot()
+                    out = self._staged.reply(work["scored"])
+                    work["d2h"] = counters.delta(dp_before)["d2h_transfers"]
+                    if rspan is not None:
+                        rspan.set_attribute("d2h_transfers", work["d2h"])
                 self._route_replies(
                     out,
                     dict(zip(work["ids"], work["exchanges"])),
                     enforce_deadline=True,
                 )
-                work["d2h"] = counters.delta(dp_before)["d2h_transfers"]
         except Exception as e:
             log.exception("reply stage failed")
             for ex in work["exchanges"]:

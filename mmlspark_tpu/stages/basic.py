@@ -183,9 +183,12 @@ class Timer(Estimator, Wrappable):
     def fit(self, df: DataFrame) -> "TimerModel":
         inner = self.get(self.stage)
         if isinstance(inner, Estimator):
-            t0 = time.time()
+            t0 = time.perf_counter()
             fitted = inner.fit(df)
-            self._log(f"{type(inner).__name__}.fit took {time.time() - t0:.3f}s")
+            self._log(
+                f"{type(inner).__name__}.fit took "
+                f"{time.perf_counter() - t0:.3f}s"
+            )
         else:
             fitted = inner
         return TimerModel(fitted)
@@ -206,10 +209,11 @@ class TimerModel(Model, Wrappable):
 
     def transform(self, df: DataFrame) -> DataFrame:
         inner = self.get(self.stage)
-        t0 = time.time()
+        t0 = time.perf_counter()
         out = inner.transform(df)
         get_logger("mmlspark_tpu.timer").info(
-            f"{type(inner).__name__}.transform took {time.time() - t0:.3f}s"
+            f"{type(inner).__name__}.transform took "
+            f"{time.perf_counter() - t0:.3f}s"
         )
         return out
 

@@ -1,4 +1,4 @@
-"""Profiling/tracing hooks over jax.profiler.
+"""Profiling/tracing hooks over jax.profiler + registry-backed meters.
 
 Reference aux subsystem (SURVEY.md §5 tracing): the Timer stage wraps
 wall-clock around a stage; these helpers add DEVICE-level visibility — a
@@ -9,22 +9,32 @@ dispatch gaps, fusion, and HBM traffic on real hardware.
     with profile_to("/tmp/trace"):
         with annotate("gbdt-fit"):
             model = clf.fit(df)
+
+The counter classes here are VIEWS over the unified metrics registry
+(mmlspark_tpu/obs/metrics.py): every record_* lands in a named registry
+instrument, so the same numbers that back `snapshot()`/`summary()` (the
+PR 3/4 bench gates) are scrapeable from a live server via ``GET /metrics``
+(docs/observability.md). `reset()` keeps its old meaning through per-field
+offsets — registry counters themselves are monotonic, as Prometheus
+requires.
 """
 
 from __future__ import annotations
 
 import contextlib
+import itertools
 import threading
 import time
 from typing import Dict, Iterator, Optional
 
 from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.obs import metrics as _metrics
 
 log = get_logger("mmlspark_tpu.profiling")
 
 
 class DataplaneCounters:
-    """Process-wide host<->device transfer and compile counters.
+    """Process-wide host<->device transfer and compile meters.
 
     The data plane (core/dataframe.py lazy column sync, core/dispatch.py
     compiled-program cache, TPUModel/mesh device_puts) reports every
@@ -33,6 +43,11 @@ class DataplaneCounters:
     (bench.py --smoke, tests/test_dataplane.py) instead of a claim. Counts
     are instrumentation-level: they track the framework's own transfer
     points, not jax-internal scalar promotion.
+
+    Registry-backed: each field is a `dataplane_*` Counter in the default
+    MetricsRegistry (scrape names below), and this class is the delta/reset
+    view the benches consume. While the registry is disabled
+    (obs.set_enabled(False)) recording is a no-op and snapshots freeze.
     """
 
     _FIELDS = ("h2d_transfers", "h2d_bytes", "d2h_transfers", "d2h_bytes",
@@ -40,33 +55,62 @@ class DataplaneCounters:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
+        reg = _metrics.registry()
+        self._instruments = {
+            "h2d_transfers": reg.counter(
+                "dataplane_h2d_transfers_total",
+                "Host->device uploads made by the framework"),
+            "h2d_bytes": reg.counter(
+                "dataplane_h2d_bytes_total",
+                "Bytes uploaded host->device"),
+            "d2h_transfers": reg.counter(
+                "dataplane_d2h_transfers_total",
+                "Device->host fetches made by the framework"),
+            "d2h_bytes": reg.counter(
+                "dataplane_d2h_bytes_total",
+                "Bytes fetched device->host"),
+            "compiles": reg.counter(
+                "dataplane_compiles_total",
+                "XLA program compiles noted by the dispatch cache"),
+        }
+        self._base = {k: 0.0 for k in self._FIELDS}
+        # a fresh instance is a fresh VIEW: it starts at zero even when the
+        # process-wide registry counters already carry traffic
         self.reset()
 
     def reset(self) -> None:
+        """Zero this VIEW (the registry counters stay monotonic)."""
         with self._lock:
-            self.h2d_transfers = 0
-            self.h2d_bytes = 0
-            self.d2h_transfers = 0
-            self.d2h_bytes = 0
-            self.compiles = 0
+            for k, inst in self._instruments.items():
+                self._base[k] = inst.value()
 
     def record_h2d(self, nbytes: int = 0) -> None:
+        # the view lock spans both incs so snapshot() (also under it) never
+        # observes a transfer counted with its bytes still lagging
         with self._lock:
-            self.h2d_transfers += 1
-            self.h2d_bytes += int(nbytes)
+            self._instruments["h2d_transfers"].inc()
+            self._instruments["h2d_bytes"].inc(int(nbytes))
 
     def record_d2h(self, nbytes: int = 0) -> None:
         with self._lock:
-            self.d2h_transfers += 1
-            self.d2h_bytes += int(nbytes)
+            self._instruments["d2h_transfers"].inc()
+            self._instruments["d2h_bytes"].inc(int(nbytes))
 
     def record_compile(self) -> None:
-        with self._lock:
-            self.compiles += 1
+        self._instruments["compiles"].inc()
+
+    def __getattr__(self, name: str) -> int:
+        # keep the old field-attribute surface (counters.h2d_transfers)
+        if name in DataplaneCounters._FIELDS:
+            return self.snapshot()[name]
+        raise AttributeError(name)
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return {k: getattr(self, k) for k in self._FIELDS}
+            return {
+                k: int(self._instruments[k].value() - self._base[k])
+                for k in self._FIELDS
+            }
 
     def delta(self, before: Dict[str, int]) -> Dict[str, int]:
         """Counter movement since a previous snapshot()."""
@@ -82,6 +126,11 @@ def dataplane_counters() -> DataplaneCounters:
     return _DATAPLANE
 
 
+#: distinct registry label per engine instance — two servers in one process
+#: must not merge their occupancy series
+_ENGINE_SEQ = itertools.count()
+
+
 class ServingPipelineCounters:
     """Occupancy and backpressure meters for the pipelined serving engine
     (serving/server.py): per-stage busy time (parse | score | reply),
@@ -94,25 +143,89 @@ class ServingPipelineCounters:
     `summary()` is the evidence base for "the device never waits on JSON
     work" — score occupancy near the wall fraction the model genuinely
     needs, with parse/reply busy time overlapped rather than serialized.
+
+    Registry-backed under an `engine` label (`serving_stage_busy_seconds_
+    total{engine=...,stage=...}` etc.), plus a scrape-time
+    `serving_stage_occupancy` callback gauge, so a live server's occupancy
+    is one `GET /metrics` away.
     """
 
     STAGES = ("parse", "score", "reply")
 
-    def __init__(self) -> None:
+    def __init__(self, engine_label: Optional[str] = None) -> None:
         self._lock = threading.Lock()
+        self.engine_label = engine_label or f"engine-{next(_ENGINE_SEQ)}"
+        reg = _metrics.registry()
+        lbl = {"engine": self.engine_label}
+        busy = reg.counter(
+            "serving_stage_busy_seconds_total",
+            "Busy seconds per pipelined serving stage",
+            ("engine", "stage"))
+        batches = reg.counter(
+            "serving_stage_batches_total",
+            "Batches through each pipelined serving stage",
+            ("engine", "stage"))
+        self._busy = {s: busy.labels(stage=s, **lbl) for s in self.STAGES}
+        self._batches = {s: batches.labels(stage=s, **lbl) for s in self.STAGES}
+        self._rows = reg.counter(
+            "serving_rows_total", "Rows through the serving engine",
+            ("engine",)).labels(**lbl)
+        self._expired = reg.counter(
+            "serving_expired_in_flight_total",
+            "Requests whose deadline passed while their batch was in flight",
+            ("engine",)).labels(**lbl)
+        dispatch = reg.counter(
+            "serving_dispatch_total",
+            "Batch dispatch decisions by the adaptive coalescer",
+            ("engine", "kind"))
+        self._dispatch = {
+            "immediate": dispatch.labels(kind="immediate", **lbl),
+            "coalesced": dispatch.labels(kind="coalesced", **lbl),
+        }
+        self._inflight = reg.gauge(
+            "serving_in_flight_batches",
+            "Batches currently between dispatch and reply-done",
+            ("engine",)).labels(**lbl)
+        self._inflight_peak = reg.gauge(
+            "serving_in_flight_peak",
+            "High-water mark of in-flight batches",
+            ("engine",)).labels(**lbl)
+        self._occ_family = reg.gauge(
+            "serving_stage_occupancy",
+            "Stage busy seconds / engine wall seconds (computed at scrape)",
+            ("engine", "stage"))
+        for s in self.STAGES:
+            self._occ_family.labels(stage=s, **lbl).set_function(
+                lambda s=s: self._occupancy(s)
+            )
+        self._base: Dict[str, float] = {}
         self.reset()
+
+    def close(self) -> None:
+        """Drop this engine's scrape-time occupancy series — their callbacks
+        close over self, so leaving them registered after the engine stops
+        would pin the whole engine object graph in the process registry.
+        Cumulative counter series remain (Prometheus counters are
+        append-only by contract)."""
+        for s in self.STAGES:
+            self._occ_family.remove(engine=self.engine_label, stage=s)
+
+    def _occupancy(self, stage: str) -> float:
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        return (self._busy[stage].value() - self._base[f"busy_{stage}"]) / elapsed
 
     def reset(self) -> None:
         with self._lock:
             self._t0 = time.monotonic()
-            self.stage_busy_s = {s: 0.0 for s in self.STAGES}
-            self.stage_batches = {s: 0 for s in self.STAGES}
-            self.rows = 0
-            self.expired_in_flight = 0
-            self.in_flight = 0
-            self.in_flight_peak = 0
-            self.immediate_dispatches = 0
-            self.coalesced_dispatches = 0
+            for s in self.STAGES:
+                self._base[f"busy_{s}"] = self._busy[s].value()
+                self._base[f"batches_{s}"] = self._batches[s].value()
+            self._base["rows"] = self._rows.value()
+            self._base["expired"] = self._expired.value()
+            for kind, inst in self._dispatch.items():
+                self._base[f"dispatch_{kind}"] = inst.value()
+            self._inflight.set(0.0)
+            self._inflight_peak.set(0.0)
 
     @contextlib.contextmanager
     def stage(self, name: str, rows: int = 0) -> Iterator[None]:
@@ -123,77 +236,111 @@ class ServingPipelineCounters:
             yield
         finally:
             dt = time.monotonic() - t0
-            with self._lock:
-                self.stage_busy_s[name] += dt
-                self.stage_batches[name] += 1
-                self.rows += rows
+            self._busy[name].inc(dt)
+            self._batches[name].inc()
+            if rows:
+                self._rows.inc(rows)
+
+    @property
+    def stage_busy_s(self) -> Dict[str, float]:
+        return {
+            s: self._busy[s].value() - self._base[f"busy_{s}"]
+            for s in self.STAGES
+        }
+
+    @property
+    def expired_in_flight(self) -> int:
+        return int(self._expired.value() - self._base["expired"])
+
+    @property
+    def in_flight(self) -> int:
+        return int(self._inflight.value())
+
+    @property
+    def in_flight_peak(self) -> int:
+        return int(self._inflight_peak.value())
 
     def enter_in_flight(self) -> None:
-        with self._lock:
-            self.in_flight += 1
-            self.in_flight_peak = max(self.in_flight_peak, self.in_flight)
+        now = self._inflight.inc(1)
+        self._inflight_peak.set_max(now)
 
     def exit_in_flight(self) -> None:
         with self._lock:
-            self.in_flight = max(0, self.in_flight - 1)
+            if self._inflight.value() > 0:
+                self._inflight.dec(1)
 
     def record_dispatch(self, immediate: bool) -> None:
-        with self._lock:
-            if immediate:
-                self.immediate_dispatches += 1
-            else:
-                self.coalesced_dispatches += 1
+        self._dispatch["immediate" if immediate else "coalesced"].inc()
 
     def record_expired(self, n: int = 1) -> None:
-        with self._lock:
-            self.expired_in_flight += n
+        self._expired.inc(n)
 
     def summary(self) -> Dict[str, float]:
         with self._lock:
             elapsed = max(time.monotonic() - self._t0, 1e-9)
             out: Dict[str, float] = {"elapsed_s": round(elapsed, 3)}
             for s in self.STAGES:
-                out[f"{s}_busy_s"] = round(self.stage_busy_s[s], 4)
-                out[f"{s}_occupancy"] = round(self.stage_busy_s[s] / elapsed, 4)
-                out[f"{s}_batches"] = float(self.stage_batches[s])
-            out["rows"] = float(self.rows)
-            out["in_flight_peak"] = float(self.in_flight_peak)
-            out["expired_in_flight"] = float(self.expired_in_flight)
-            out["immediate_dispatches"] = float(self.immediate_dispatches)
-            out["coalesced_dispatches"] = float(self.coalesced_dispatches)
+                busy = self._busy[s].value() - self._base[f"busy_{s}"]
+                out[f"{s}_busy_s"] = round(busy, 4)
+                out[f"{s}_occupancy"] = round(busy / elapsed, 4)
+                out[f"{s}_batches"] = float(
+                    self._batches[s].value() - self._base[f"batches_{s}"]
+                )
+            out["rows"] = float(self._rows.value() - self._base["rows"])
+            out["in_flight_peak"] = float(self._inflight_peak.value())
+            out["expired_in_flight"] = float(
+                self._expired.value() - self._base["expired"]
+            )
+            out["immediate_dispatches"] = float(
+                self._dispatch["immediate"].value()
+                - self._base["dispatch_immediate"]
+            )
+            out["coalesced_dispatches"] = float(
+                self._dispatch["coalesced"].value()
+                - self._base["dispatch_coalesced"]
+            )
             return out
 
 
 @contextlib.contextmanager
 def profile_to(logdir: str) -> Iterator[None]:
     """Capture a jax.profiler device trace into `logdir` (TensorBoard
-    format). Wall-clock for the block is logged either way."""
+    format). Wall-clock for the block is logged either way — including when
+    the traced block raises (a failed fit still reports its traced time)."""
     import jax
 
     t0 = time.perf_counter()
-    with jax.profiler.trace(logdir):
-        yield
-    log.info("profile_to(%s): %.3fs traced", logdir, time.perf_counter() - t0)
+    try:
+        with jax.profiler.trace(logdir):
+            yield
+    finally:
+        log.info(
+            "profile_to(%s): %.3fs traced", logdir, time.perf_counter() - t0
+        )
 
 
 @contextlib.contextmanager
 def annotate(name: str, **kwargs) -> Iterator[None]:
     """Named region that shows up inside device traces (TraceAnnotation);
-    also logs host wall-clock at debug level."""
+    also logs host wall-clock at debug level (even when the block raises)."""
     import jax
 
     t0 = time.perf_counter()
-    with jax.profiler.TraceAnnotation(name, **kwargs):
-        yield
-    log.debug("annotate(%s): %.3fs", name, time.perf_counter() - t0)
+    try:
+        with jax.profiler.TraceAnnotation(name, **kwargs):
+            yield
+    finally:
+        log.debug("annotate(%s): %.3fs", name, time.perf_counter() - t0)
 
 
 class StageTimer:
     """Accumulating named timer for host-side phases (the Timer stage's
     programmatic sibling): timer.time('binning') blocks accumulate and
-    report() returns {name: seconds}."""
+    report() returns {name: seconds}. Thread-safe: serving handlers run it
+    from parse/reply thread pools concurrently."""
 
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self._acc: dict = {}
 
     @contextlib.contextmanager
@@ -202,7 +349,10 @@ class StageTimer:
         try:
             yield
         finally:
-            self._acc[name] = self._acc.get(name, 0.0) + time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._acc[name] = self._acc.get(name, 0.0) + dt
 
     def report(self) -> dict:
-        return dict(self._acc)
+        with self._lock:
+            return dict(self._acc)

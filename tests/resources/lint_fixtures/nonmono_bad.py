@@ -1,0 +1,73 @@
+"""Fixture for the non-monotonic-duration rule: wall-clock readings feeding
+duration/deadline math. Parsed, never imported."""
+
+import time
+from time import time as wall
+
+
+def measure_fit(model, df):
+    t0 = time.time()
+    model.fit(df)
+    return time.time() - t0  # expect[non-monotonic-duration]
+
+
+def tainted_through_names(work):
+    start = time.time()
+    work()
+    now = time.time()
+    elapsed = now - start  # expect[non-monotonic-duration]
+    return elapsed
+
+
+def deadline_poll(event, timeout):
+    deadline = time.time() + timeout
+    while time.time() < deadline:  # expect[non-monotonic-duration]
+        if event.is_set():
+            return True
+    return False
+
+
+def justified_wall_anchor():
+    # epoch anchor for trace export: an absolute timestamp is the one
+    # legitimate wall-clock use — and even its drift correction is allowed
+    # when explicitly justified
+    anchor = time.time()
+    skew = anchor - 1_700_000_000.0  # graftcheck: ignore[non-monotonic-duration]  # expect-suppressed[non-monotonic-duration]
+    return anchor, skew
+
+
+def nested_assignment_still_taints(cond, now):
+    if cond:
+        t0 = time.time()  # nested in a branch: document-order taint
+    else:
+        t0 = 0.0
+    return now - t0  # expect[non-monotonic-duration]
+
+
+def aliased_import_is_still_wall_clock(work):
+    start = wall()
+    work()
+    return wall() - start  # expect[non-monotonic-duration]
+
+
+def clean_timestamp(record):
+    # bare wall-clock timestamp, no arithmetic: clean
+    record["logged_at"] = time.time()
+    return record
+
+
+def clean_monotonic(work):
+    t0 = time.monotonic()
+    work()
+    return time.monotonic() - t0  # monotonic duration: clean
+
+
+def closure_scopes_are_independent():
+    t0 = time.time()  # timestamp only in THIS scope: clean
+
+    def inner(work):
+        s = time.perf_counter()
+        work()
+        return time.perf_counter() - s  # clean: no taint inherited
+
+    return t0, inner

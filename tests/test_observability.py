@@ -1,0 +1,701 @@
+"""Unified observability layer tests (ISSUE 5): the metrics registry
+(labelled instruments, streaming quantile sketch, Prometheus exposition,
+thread-safety under hammer), the request tracer (span trees, propagation,
+JSONL + Chrome export, bounded retention), the registry-backed profiling
+counters, and the ServingServer surfaces (/metrics, /healthz, per-request
+span path, slow-request logging)."""
+
+import http.client
+import json
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs.metrics import (
+    MetricsRegistry,
+    QuantileSketch,
+    parse_prometheus,
+)
+from mmlspark_tpu.obs.tracing import Tracer, current_span
+from mmlspark_tpu.utils.profiling import (
+    ServingPipelineCounters,
+    StageTimer,
+    dataplane_counters,
+)
+
+N_THREADS = 8
+N_OPS = 2000
+
+
+def _hammer(fn, n_threads=N_THREADS):
+    threads = [
+        threading.Thread(target=fn, args=(i,)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+# -- quantile sketch ----------------------------------------------------------
+
+
+class TestQuantileSketch:
+    def test_small_stream_is_exact(self):
+        s = QuantileSketch(k=128)
+        for v in range(1, 101):
+            s.add(float(v))
+        assert s.count == 100 and s.min == 1.0 and s.max == 100.0
+        assert s.quantile(0.0) == 1.0
+        assert s.quantile(1.0) == 100.0
+        assert abs(s.quantile(0.5) - 50.0) <= 1.0
+
+    def test_bounded_memory_and_monotone_quantiles(self):
+        s = QuantileSketch(k=64)
+        rng = np.random.default_rng(0)
+        values = rng.exponential(10.0, size=100_000)
+        for v in values:
+            s.add(float(v))
+        # bounded: levels hold at most k items each, level count is
+        # logarithmic — far below the stream length
+        retained = sum(len(lvl) for lvl in s._levels)
+        assert retained <= 64 * len(s._levels) < 2000
+        qs = [s.quantile(q) for q in (0.1, 0.25, 0.5, 0.75, 0.95, 0.99)]
+        assert qs == sorted(qs), qs
+        assert all(s.min <= q <= s.max for q in qs)
+        # rank accuracy sanity: p50 of an exp(10) stream is ~6.93
+        assert abs(qs[2] - np.median(values)) / np.median(values) < 0.25
+
+    def test_empty_is_nan(self):
+        s = QuantileSketch()
+        assert s.quantile(0.5) != s.quantile(0.5)  # NaN
+
+
+# -- instruments under concurrency (satellite: exact totals) ------------------
+
+
+class TestInstrumentsConcurrent:
+    def test_counter_exact_total_across_threads(self):
+        reg = MetricsRegistry()
+        c = reg.counter("hammer_total", "t", ("worker",))
+
+        def work(i):
+            child = c.labels(worker=str(i % 2))
+            for _ in range(N_OPS):
+                child.inc()
+
+        _hammer(work)
+        total = sum(
+            child.value() for _key, child in c.children()
+        )
+        assert total == N_THREADS * N_OPS
+        assert c.labels(worker="0").value() == N_THREADS * N_OPS / 2
+
+    def test_histogram_exact_count_sum_and_sketch_bounds(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_ms", "t", quantiles=(0.5, 0.95, 0.99))
+
+        def work(i):
+            for j in range(N_OPS):
+                h.observe(float(j % 100))
+
+        _hammer(work)
+        assert h.count() == N_THREADS * N_OPS
+        # integers sum exactly in f64 at this magnitude
+        assert h.sum() == N_THREADS * sum(j % 100 for j in range(N_OPS))
+        q50, q95, q99 = (h.quantile(q) for q in (0.5, 0.95, 0.99))
+        assert 0.0 <= q50 <= q95 <= q99 <= 99.0
+
+    def test_gauge_set_max_races_to_true_peak(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("peak", "t")
+
+        def work(i):
+            for j in range(N_OPS):
+                g.labels().set_max(float(i * N_OPS + j))
+
+        _hammer(work)
+        assert g.value() == float(N_THREADS * N_OPS - 1)
+
+    def test_counter_rejects_negative(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError, match="only go up"):
+            reg.counter("c_total").inc(-1)
+
+
+# -- registry semantics -------------------------------------------------------
+
+
+class TestRegistry:
+    def test_get_or_create_returns_same_family(self):
+        reg = MetricsRegistry()
+        assert reg.counter("a_total") is reg.counter("a_total")
+
+    def test_type_mismatch_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x_total")
+        with pytest.raises(ValueError, match="re-registered"):
+            reg.gauge("x_total")
+
+    def test_label_mismatch_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("y_total", labelnames=("a",))
+        with pytest.raises(ValueError, match="re-registered"):
+            reg.counter("y_total", labelnames=("b",))
+
+    def test_disabled_registry_noops(self):
+        reg = MetricsRegistry()
+        c = reg.counter("z_total")
+        h = reg.histogram("z_ms")
+        reg.set_enabled(False)
+        c.inc()
+        h.observe(5.0)
+        assert c.value() == 0 and h.count() == 0
+        reg.set_enabled(True)
+        c.inc()
+        assert c.value() == 1
+
+    def test_render_parse_round_trip_with_escaping(self):
+        reg = MetricsRegistry()
+        c = reg.counter("esc_total", "weird labels", ("path",))
+        c.labels(path='a"b\\c\nd').inc(3)
+        g = reg.gauge("plain", "no labels")
+        g.set(2.5)
+        text = reg.render_prometheus()
+        parsed = parse_prometheus(text)
+        assert parsed[("plain", ())] == 2.5
+        assert parsed[("esc_total", (("path", 'a"b\\c\nd'),))] == 3.0
+
+    def test_literal_backslash_n_round_trips(self):
+        """'C:\\nightly' must not decode to a newline: unescaping is a
+        left-to-right scan, not ordered str.replace."""
+        reg = MetricsRegistry()
+        reg.counter("bs_total", "", ("path",)).labels(
+            path="C:\\nightly"
+        ).inc()
+        parsed = parse_prometheus(reg.render_prometheus())
+        assert parsed[("bs_total", (("path", "C:\\nightly"),))] == 1.0
+
+    def test_histogram_quantile_mismatch_raises(self):
+        reg = MetricsRegistry()
+        reg.histogram("q_ms", quantiles=(0.5,))
+        with pytest.raises(ValueError, match="re-registered"):
+            reg.histogram("q_ms", quantiles=(0.5, 0.999))
+
+    def test_callback_gauge_reads_at_scrape(self):
+        reg = MetricsRegistry()
+        box = {"v": 1.0}
+        reg.gauge("cb").set_function(lambda: box["v"])
+        assert parse_prometheus(reg.render_prometheus())[("cb", ())] == 1.0
+        box["v"] = 7.0
+        assert parse_prometheus(reg.render_prometheus())[("cb", ())] == 7.0
+
+
+# -- registry-backed profiling counters ---------------------------------------
+
+
+class TestProfilingCountersConcurrent:
+    def test_dataplane_counters_exact_under_hammer(self):
+        c = dataplane_counters()
+        before = c.snapshot()
+
+        def work(i):
+            for _ in range(N_OPS):
+                c.record_h2d(8)
+                c.record_d2h(4)
+
+        _hammer(work)
+        delta = c.delta(before)
+        assert delta["h2d_transfers"] == N_THREADS * N_OPS
+        assert delta["h2d_bytes"] == N_THREADS * N_OPS * 8
+        assert delta["d2h_transfers"] == N_THREADS * N_OPS
+        assert delta["d2h_bytes"] == N_THREADS * N_OPS * 4
+
+    def test_fresh_dataplane_view_starts_at_zero(self):
+        from mmlspark_tpu.utils.profiling import DataplaneCounters
+
+        dataplane_counters().record_h2d(64)  # pre-existing process traffic
+        fresh = DataplaneCounters()
+        assert fresh.snapshot() == {
+            k: 0 for k in DataplaneCounters._FIELDS
+        }
+
+    def test_dataplane_reset_is_view_local(self):
+        c = dataplane_counters()
+        c.record_h2d(1)
+        c.reset()
+        assert c.snapshot()["h2d_transfers"] == 0
+        c.record_h2d(1)
+        assert c.h2d_transfers == 1  # attribute surface preserved
+
+    def test_serving_pipeline_counters_exact_under_hammer(self):
+        p = ServingPipelineCounters()
+        reps = 200
+
+        def work(i):
+            for _ in range(reps):
+                with p.stage("parse", rows=2):
+                    pass
+                with p.stage("reply"):
+                    pass
+                p.enter_in_flight()
+                p.record_dispatch(immediate=(i % 2 == 0))
+                p.exit_in_flight()
+
+        _hammer(work)
+        s = p.summary()
+        assert s["parse_batches"] == N_THREADS * reps
+        assert s["reply_batches"] == N_THREADS * reps
+        assert s["rows"] == N_THREADS * reps * 2
+        assert (
+            s["immediate_dispatches"] + s["coalesced_dispatches"]
+            == N_THREADS * reps
+        )
+        assert p.in_flight == 0
+        assert 1 <= p.in_flight_peak <= N_THREADS
+        assert s["parse_occupancy"] >= 0.0
+
+    def test_serving_counters_are_scrapeable(self):
+        p = ServingPipelineCounters(engine_label="scrape-test")
+        with p.stage("score"):
+            pass
+        text = obs.registry().render_prometheus()
+        parsed = parse_prometheus(text)
+        key = (
+            "serving_stage_batches_total",
+            (("engine", "scrape-test"), ("stage", "score")),
+        )
+        assert parsed[key] == 1.0
+
+
+# -- StageTimer thread-safety (satellite) -------------------------------------
+
+
+def test_stage_timer_concurrent_accumulation():
+    t = StageTimer()
+
+    def work(i):
+        for _ in range(500):
+            with t.time("shared"):
+                pass
+            with t.time(f"own-{i}"):
+                pass
+
+    _hammer(work)
+    rep = t.report()
+    # no lost names, and the shared accumulator saw every block
+    assert set(rep) == {"shared"} | {f"own-{i}" for i in range(N_THREADS)}
+    assert rep["shared"] > 0
+
+
+# -- profile_to / annotate log in finally (satellite) -------------------------
+
+
+def test_profile_to_logs_wall_clock_when_block_raises(tmp_path, caplog):
+    from mmlspark_tpu.utils import profile_to
+
+    with caplog.at_level(logging.INFO, logger="mmlspark_tpu.profiling"):
+        with pytest.raises(RuntimeError, match="boom"):
+            with profile_to(str(tmp_path / "trace")):
+                raise RuntimeError("boom")
+    assert any("profile_to" in r.message for r in caplog.records)
+
+
+def test_annotate_logs_wall_clock_when_block_raises(caplog):
+    from mmlspark_tpu.utils import annotate
+
+    with caplog.at_level(logging.DEBUG, logger="mmlspark_tpu.profiling"):
+        with pytest.raises(ValueError, match="nope"):
+            with annotate("failing-region"):
+                raise ValueError("nope")
+    assert any("failing-region" in r.message for r in caplog.records)
+
+
+# -- tracer -------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_context_nesting_builds_parent_links(self):
+        tr = Tracer()
+        with tr.span("root") as root:
+            assert current_span() is root
+            with tr.span("child") as child:
+                assert child.parent_id == root.span_id
+                assert child.trace_id == root.trace_id
+        assert current_span() is None
+        names = [s.name for s in tr.spans(root.trace_id)]
+        assert names == ["child", "root"]  # children end first
+
+    def test_explicit_parent_crosses_threads(self):
+        tr = Tracer()
+        root = tr.start_span("http")
+        done = threading.Event()
+        holder = {}
+
+        def worker():
+            with tr.activate(root):
+                with tr.span("score") as s:
+                    holder["span"] = s
+            done.set()
+
+        threading.Thread(target=worker).start()
+        assert done.wait(5)
+        tr.end_span(root)
+        assert holder["span"].parent_id == root.span_id
+        assert holder["span"].trace_id == root.trace_id
+
+    def test_add_span_retroactive(self):
+        tr = Tracer()
+        root = tr.start_span("http")
+        t0 = time.monotonic()
+        span = tr.add_span("parse", root, t0, t0 + 0.25, attrs={"n": 4})
+        tr.end_span(root)
+        assert span.parent_id == root.span_id
+        assert abs(span.duration_ms() - 250.0) < 1.0
+
+    def test_error_attr_on_raise(self):
+        tr = Tracer()
+        with pytest.raises(KeyError):
+            with tr.span("boom") as s:
+                raise KeyError("x")
+        assert "KeyError" in s.attrs["error"]
+
+    def test_bounded_retention(self):
+        tr = Tracer(max_spans=10)
+        for i in range(50):
+            with tr.span(f"s{i}"):
+                pass
+        spans = tr.spans()
+        assert len(spans) == 10
+        assert spans[-1].name == "s49"
+
+    def test_disabled_tracer_noops(self):
+        tr = Tracer()
+        tr.set_enabled(False)
+        with tr.span("invisible") as s:
+            assert not s.recording
+            s.set_attribute("k", "v")  # no-op, no crash
+        assert tr.spans() == []
+        tr.set_enabled(True)
+
+    def test_jsonl_export(self, tmp_path):
+        tr = Tracer()
+        with tr.span("a", key="v"):
+            with tr.span("b"):
+                pass
+        path = str(tmp_path / "spans.jsonl")
+        n = tr.export_jsonl(path)
+        assert n == 2
+        lines = [json.loads(x) for x in open(path).read().splitlines()]
+        by_name = {d["name"]: d for d in lines}
+        assert by_name["b"]["parent_id"] == by_name["a"]["span_id"]
+        assert by_name["a"]["attrs"] == {"key": "v"}
+        assert by_name["a"]["duration_ms"] >= 0
+
+    def test_chrome_trace_export(self, tmp_path):
+        tr = Tracer()
+        with tr.span("stage") as s:
+            s.add_event("h2d_upload", nbytes=64)
+        path = str(tmp_path / "trace.json")
+        n = tr.export_chrome_trace(path)
+        assert n == 2  # one X span + one i event
+        doc = json.load(open(path))
+        evs = doc["traceEvents"]
+        complete = [e for e in evs if e["ph"] == "X"]
+        instants = [e for e in evs if e["ph"] == "i"]
+        assert complete[0]["name"] == "stage"
+        assert {"ts", "dur", "pid", "tid"} <= set(complete[0])
+        assert instants[0]["name"] == "h2d_upload"
+        assert instants[0]["args"] == {"nbytes": 64}
+
+
+def test_obs_disabled_scopes_both_layers():
+    with obs.disabled():
+        assert not obs.registry().enabled
+        assert not obs.tracer().enabled
+    assert obs.registry().enabled and obs.tracer().enabled
+
+
+# -- pipeline spans + stage histograms ----------------------------------------
+
+
+def test_pipeline_transform_emits_stage_spans_and_histograms():
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.core.pipeline import PipelineModel
+    from mmlspark_tpu.stages.basic import DropColumns, RenameColumn
+
+    tr = obs.tracer()
+    tr.clear()
+    df = DataFrame.from_dict({"a": np.arange(4.0), "b": np.arange(4.0)})
+    pm = PipelineModel([
+        RenameColumn(input_col="a", output_col="a2"),
+        DropColumns(cols=["b"]),
+    ])
+    with tr.span("request") as root:
+        pm.transform(df)
+    names = [s.name for s in tr.spans(root.trace_id)]
+    assert "stage:RenameColumn" in names and "stage:DropColumns" in names
+    hist = obs.registry().histogram(
+        "pipeline_stage_seconds",
+        "Wall seconds per pipeline stage transform", ("stage",),
+    )
+    assert hist.labels(stage="DropColumns").count() >= 1
+
+
+def test_gbdt_fit_emits_phase_metrics():
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+    from mmlspark_tpu.utils import generate_dataset
+
+    hist = obs.registry().histogram(
+        "gbdt_phase_seconds", "Wall seconds per GBDT training phase",
+        ("phase",),
+    )
+    before = hist.labels(phase="binning").count()
+    df = generate_dataset({"features": "vector", "label": "label"}, 60, seed=1)
+    LightGBMClassifier(num_iterations=2, num_leaves=4).fit(df)
+    assert hist.labels(phase="binning").count() == before + 1
+
+
+# -- serving integration ------------------------------------------------------
+
+
+def _staged_handler():
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.core.dataframe import DataType
+    from mmlspark_tpu.serving import (
+        StagedServingHandler,
+        make_reply,
+        parse_request,
+    )
+
+    class Staged(StagedServingHandler):
+        def parse(self, df):
+            parsed = parse_request(df, {"x": DataType.VECTOR})
+            parsed.column("x").device_values()
+            return parsed
+
+        def score(self, df):
+            y = df.column("x").device_values() * 2.0
+            return df.with_column("y", y, DataType.VECTOR)
+
+        def reply(self, df):
+            return make_reply(df, "y")
+
+    return Staged()
+
+
+def _post(port, route, payload):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    conn.request("POST", route, json.dumps(payload).encode(),
+                 {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+def _get(port, route):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    conn.request("GET", route)
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+class TestServingObservability:
+    def test_metrics_healthz_and_span_tree(self, tmp_path):
+        from mmlspark_tpu.serving import ServingServer
+
+        tr = obs.tracer()
+        tr.clear()
+        with ServingServer(
+            _staged_handler(), api_name="score", mode="micro_batch"
+        ) as srv:
+            for i in range(3):
+                status, body = _post(srv.port, "/score", {"x": [1.0, float(i)]})
+                assert status == 200, body
+
+            # /metrics: Prometheus text with the acceptance families
+            status, body = _get(srv.port, "/metrics")
+            assert status == 200
+            parsed = parse_prometheus(body.decode())
+            names = {name for name, _ in parsed}
+            for required in (
+                "serving_request_latency_ms_count",
+                "serving_stage_busy_seconds_total",
+                "serving_stage_occupancy",
+                "serving_queue_depth",
+                "dataplane_h2d_transfers_total",
+                "dataplane_d2h_transfers_total",
+                "dataplane_compiles_total",
+            ):
+                assert required in names, f"missing {required}"
+            # the latency summary carries p50/p99 quantile series
+            assert any(
+                name == "serving_request_latency_ms"
+                and dict(labels).get("quantile") == "0.99"
+                for name, labels in parsed
+            )
+
+            # /healthz: live engine state
+            status, body = _get(srv.port, "/healthz")
+            health = json.loads(body)
+            assert status == 200, health
+            assert health["status"] == "ok"
+            assert health["threads"] == {"dispatch": True, "score": True}
+            assert health["queue_depth"] == 0
+            assert health["last_dispatch_age_s"] is not None
+            assert health["uptime_s"] > 0
+
+            # unknown routes still 404
+            status, _ = _post(srv.port, "/nope", {})
+            assert status == 404
+
+        # span tree: every request's trace has the full stage path
+        http_spans = [s for s in tr.spans() if s.name == "http"]
+        assert len(http_spans) >= 3
+        tree = {s.name for s in tr.spans(http_spans[-1].trace_id)}
+        assert {"http", "parse", "score", "reply"} <= tree
+        root = http_spans[-1]
+        children = [
+            s for s in tr.spans(root.trace_id)
+            if s.parent_id == root.span_id
+        ]
+        assert {"parse", "score", "reply"} <= {s.name for s in children}
+        assert root.attrs["status_code"] == 200
+        assert root.attrs["request_id"]
+
+        # exports: JSONL and Chrome trace (Perfetto-loadable)
+        jl = str(tmp_path / "req.jsonl")
+        assert tr.export_jsonl(jl, trace_id=root.trace_id) >= 4
+        ct = str(tmp_path / "req.trace.json")
+        assert tr.export_chrome_trace(ct, trace_id=root.trace_id) >= 4
+        doc = json.load(open(ct))
+        assert {"http", "parse", "score", "reply"} <= {
+            e["name"] for e in doc["traceEvents"] if e["ph"] == "X"
+        }
+
+    def test_health_degrades_on_stop(self):
+        from mmlspark_tpu.serving import ServingServer
+
+        srv = ServingServer(
+            _staged_handler(), api_name="score", mode="micro_batch"
+        ).start()
+        ok, info = srv.health()
+        assert ok and info["status"] == "ok"
+        srv.stop()
+        ok, info = srv.health()
+        assert not ok and info["status"] == "stopping"
+
+    def test_stop_unregisters_callback_series(self):
+        """Scrape-time gauges close over the server object; stop() must
+        remove them so the registry neither pins stopped servers nor keeps
+        reporting their stale liveness."""
+        from mmlspark_tpu.serving import ServingServer
+
+        srv = ServingServer(
+            _staged_handler(), api_name="score", mode="micro_batch"
+        ).start()
+        label = srv._obs_label
+        live = parse_prometheus(obs.registry().render_prometheus())
+        assert ("serving_queue_depth", (("engine", label),)) in live
+        assert (
+            "serving_stage_occupancy",
+            (("engine", label), ("stage", "parse")),
+        ) in live
+        srv.stop()
+        after = parse_prometheus(obs.registry().render_prometheus())
+        assert ("serving_queue_depth", (("engine", label),)) not in after
+        assert not any(
+            name == "serving_stage_occupancy"
+            and dict(labels).get("engine") == label
+            for name, labels in after
+        )
+        # cumulative counter series survive (Prometheus append-only)
+        assert any(
+            name == "serving_stage_batches_total"
+            and dict(labels).get("engine") == label
+            for name, labels in after
+        )
+
+    def test_continuous_mode_has_endpoints_and_spans(self):
+        from mmlspark_tpu.serving import ServingServer
+
+        tr = obs.tracer()
+        tr.clear()
+
+        def handler(df):
+            from mmlspark_tpu.serving import make_reply, parse_request
+
+            parsed = parse_request(df)
+            vals = np.asarray([float(v) for v in parsed["x"]])
+            from mmlspark_tpu.core.dataframe import DataType
+
+            return make_reply(
+                parsed.with_column("y", vals * 2.0, DataType.DOUBLE), "y"
+            )
+
+        with ServingServer(handler, api_name="cont") as srv:
+            status, _ = _post(srv.port, "/cont", {"x": 2.0})
+            assert status == 200
+            status, body = _get(srv.port, "/healthz")
+            assert status == 200
+            assert json.loads(body)["threads"] == {}  # no engine threads
+        http_spans = [s for s in tr.spans() if s.name == "http"]
+        assert http_spans
+        tree = {s.name for s in tr.spans(http_spans[-1].trace_id)}
+        assert {"http", "score"} <= tree  # continuous: handler IS the score
+
+    def test_slow_request_logging_carries_span_path(self, caplog):
+        from mmlspark_tpu.serving import ServingServer
+
+        with caplog.at_level(logging.WARNING, logger="mmlspark_tpu.serving"):
+            with ServingServer(
+                _staged_handler(), api_name="score", mode="micro_batch",
+                slow_request_ms=0.0,  # everything is an outlier
+            ) as srv:
+                status, _ = _post(srv.port, "/score", {"x": [1.0, 2.0]})
+                assert status == 200
+        slow = [r for r in caplog.records if "slow request" in r.message]
+        assert slow, "no slow-request log emitted"
+        msg = slow[0].getMessage()
+        assert "http" in msg and "ms" in msg
+
+    def test_distributed_gateway_serves_obs_endpoints(self):
+        from mmlspark_tpu.serving import DistributedServingServer
+
+        with DistributedServingServer(
+            _staged_handler, n_workers=2, api_name="pool",
+            mode="micro_batch",
+        ) as srv:
+            assert _post(srv.port, "/pool", {"x": [1.0, 1.0]})[0] == 200
+            status, body = _get(srv.port, "/metrics")
+            assert status == 200
+            assert "serving_request_latency_ms" in body.decode()
+            status, body = _get(srv.port, "/healthz")
+            health = json.loads(body)
+            assert status == 200, health
+            assert health["status"] == "ok"
+            assert len(health["workers"]) == 2
+            assert all(w["status"] == "ok" for w in health["workers"])
+
+    def test_request_latency_histogram_labels_status(self):
+        from mmlspark_tpu.serving import ServingServer
+
+        with ServingServer(
+            _staged_handler(), api_name="score", mode="micro_batch"
+        ) as srv:
+            label = srv._obs_label
+            assert _post(srv.port, "/score", {"x": [1.0, 1.0]})[0] == 200
+            hist = obs.registry().histogram(
+                "serving_request_latency_ms",
+                "End-to-end request latency at the HTTP edge",
+                ("engine", "code"),
+            )
+            assert hist.labels(engine=label, code="200").count() >= 1

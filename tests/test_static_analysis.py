@@ -179,6 +179,49 @@ def test_lock_scope_config_key_loads(tmp_path):
     assert load_config(REPO).lock_names == ["_model_lock"]  # default
 
 
+# -- monotonic time -----------------------------------------------------------
+
+
+def test_non_monotonic_duration_fires_and_suppresses():
+    from mmlspark_tpu.analysis.monotonic_time import check_monotonic_time
+
+    path = os.path.join(FIXTURES, "nonmono_bad.py")
+    findings = check_monotonic_time([path], repo_root=FIXTURES)
+    _assert_matches_markers("nonmono_bad.py", findings)
+
+
+def test_non_monotonic_rule_allows_bare_timestamps_and_monotonic():
+    """A bare time.time() with no duration math, and any time.monotonic/
+    perf_counter arithmetic, must not be flagged."""
+    from mmlspark_tpu.analysis.monotonic_time import check_monotonic_time
+
+    path = os.path.join(FIXTURES, "nonmono_bad.py")
+    findings = check_monotonic_time([path], repo_root=FIXTURES)
+    with open(path) as f:
+        clean_lines = {
+            i for i, line in enumerate(f, start=1) if "clean" in line
+        }
+    assert not {f.line for f in findings} & clean_lines
+
+
+def test_non_monotonic_rule_scopes_taint_per_function(tmp_path):
+    """A wall read in an enclosing scope must not taint a nested function's
+    own (correct) perf_counter math."""
+    from mmlspark_tpu.analysis.monotonic_time import check_monotonic_time
+
+    p = tmp_path / "scoped.py"
+    p.write_text(
+        "import time\n\n"
+        "def outer():\n"
+        "    t0 = time.time()\n"
+        "    def inner():\n"
+        "        s = time.perf_counter()\n"
+        "        return time.perf_counter() - s\n"
+        "    return t0, inner\n"
+    )
+    assert check_monotonic_time([str(p)], repo_root=str(tmp_path)) == []
+
+
 # -- schema flow --------------------------------------------------------------
 
 
