@@ -944,6 +944,228 @@ def run_obs_overhead_smoke(out_path: str = "BENCH_pr05.json") -> dict:
     return report
 
 
+def run_fault_smoke(out_path: str = "BENCH_pr06.json") -> dict:
+    """Fault-tolerance smoke bench (CPU-safe; wired into tier-1 via
+    tests/test_bench_smoke.py): the serving fabric's acceptance scenarios
+    (ISSUE 6), written to BENCH_pr06.json.
+
+    - kill_1_of_4: closed-loop load over a 4-worker pool; worker 2 is
+      killed mid-load (listening socket abruptly closed). Gate: client
+      error rate < 1%, the router ejects the dead worker in < 500 ms,
+      p99 stays bounded.
+    - wedge_breaker: worker 1 stops answering (accepted-but-wedged,
+      injected at the transport). Gate: its circuit breaker trips, traffic
+      rebalances with error rate < 1% and bounded p99.
+    - overload_shed: offered load at 4x the admission limit. Gate: excess
+      sheds as fast 429s while the p99 of ADMITTED requests stays within
+      2x of the unloaded baseline (shedding protects the served traffic).
+    - replace_under_load: replace_worker() hot-swaps a worker mid-load.
+      Gate: zero failed requests (the drain flushes in-flight first).
+
+    Faults come from serving/faults.py — kill closes real sockets, the
+    wedge raises the same socket.timeout a real unresponsive peer produces
+    — so the gateway code under test cannot tell the scenarios from
+    production failures.
+    """
+    import http.client
+    import itertools
+    import threading
+
+    import jax
+
+    from mmlspark_tpu.core.dataframe import DataType
+    from mmlspark_tpu.serving import (
+        DistributedServingServer,
+        FabricConfig,
+        FaultInjector,
+        make_reply,
+        parse_request,
+    )
+
+    def echo_factory(delay_s=0.002):
+        def factory():
+            def handler(df):
+                time.sleep(delay_s)
+                parsed = parse_request(df, {"x": None})
+                vals = np.asarray([float(v) * 2.0 for v in parsed["x"]])
+                return make_reply(
+                    parsed.with_column("y", vals, DataType.DOUBLE), "y"
+                )
+            return handler
+        return factory
+
+    def tolerant_load(port, api, n_clients, n_requests, on_request=None):
+        """Closed-loop load that RECORDS failures instead of raising (the
+        whole point is measuring the error rate under faults). Returns
+        (statuses, sorted 200-latencies seconds)."""
+        statuses, lat, lock = [], [], threading.Lock()
+        counter = itertools.count()
+
+        def client(cid):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.connect()  # untimed: measure requests, not SYN handshakes
+            body = json.dumps({"x": float(cid)}).encode()
+            for _ in range(n_requests):
+                seq = next(counter)
+                if on_request is not None:
+                    on_request(seq)
+                t0 = time.perf_counter()
+                try:
+                    conn.request("POST", f"/{api}", body,
+                                 {"Content-Type": "application/json"})
+                    r = conn.getresponse()
+                    r.read()
+                    status = r.status
+                except OSError:
+                    status = -1  # transport failure at the client
+                    conn.close()
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", port, timeout=30
+                    )
+                dt = time.perf_counter() - t0
+                with lock:
+                    statuses.append(status)
+                    if status == 200:
+                        lat.append(dt)
+            conn.close()
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return statuses, sorted(lat)
+
+    def stats(statuses, lat):
+        bad = [s for s in statuses if s != 200]
+        return {
+            "requests": len(statuses),
+            "errors": len(bad),
+            "error_rate": round(len(bad) / max(1, len(statuses)), 4),
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 3) if lat else None,
+            "p99_ms": (
+                round(lat[int(len(lat) * 0.99)] * 1e3, 3) if lat else None
+            ),
+        }
+
+    fast = FabricConfig(
+        failure_threshold=2, open_secs=0.2, health_interval_s=0.05,
+        backoff_base_ms=1.0, backoff_max_ms=5.0,
+    )
+
+    # -- scenario 1: kill 1 of 4 under closed-loop load ------------------------
+    faults = FaultInjector()
+    t_kill = [None]
+    with DistributedServingServer(
+        echo_factory(), n_workers=4, api_name="fault",
+        fabric=fast, worker_timeout=0.5, fault_injector=faults,
+    ) as srv:
+        warm, _ = tolerant_load(srv.port, "fault", 4, 4)
+        assert all(s == 200 for s in warm), warm
+
+        kill_at = 80  # ~1/4 through the 8x40 load
+
+        def maybe_kill(seq):
+            if seq == kill_at:
+                t_kill[0] = time.monotonic()  # the fabric's clock
+                faults.kill_worker(srv, 2)
+
+        statuses, lat = tolerant_load(
+            srv.port, "fault", 8, 40, on_request=maybe_kill
+        )
+        kill_stats = stats(statuses, lat)
+        # recovery = kill -> the router's OWN first observation that the
+        # worker is unroutable (health flip or breaker open); event-driven,
+        # so measurement-thread scheduling can't inflate it
+        ejected_at = srv.fabric.unroutable_since(2)
+        kill_stats["recovery_ms"] = (
+            round((ejected_at - t_kill[0]) * 1e3, 1)
+            if ejected_at is not None and t_kill[0] is not None else None
+        )
+        kill_stats["router"] = srv.fabric.snapshot()["workers"]
+
+    # -- scenario 2: wedged worker trips its breaker ---------------------------
+    faults = FaultInjector()
+    with DistributedServingServer(
+        echo_factory(), n_workers=4, api_name="wedge",
+        fabric=fast, worker_timeout=0.25, fault_injector=faults,
+    ) as srv:
+        tolerant_load(srv.port, "wedge", 4, 4)  # warm
+        faults.wedge_worker(1)
+        statuses, lat = tolerant_load(srv.port, "wedge", 8, 30)
+        snap = srv.fabric.snapshot()
+        wedge_stats = stats(statuses, lat)
+        wedge_stats["breaker_worker1"] = snap["workers"][1]["breaker"]
+        wedge_stats["breaker_tripped"] = snap["workers"][1]["breaker"] in (
+            "open", "half_open"
+        )
+
+    # -- scenario 3: overload sheds, admitted traffic stays fast ---------------
+    shed_cfg = FabricConfig(
+        admission_initial=4, admission_min=4, admission_max=4,
+        failure_threshold=2, open_secs=0.2,
+    )
+    with DistributedServingServer(
+        echo_factory(delay_s=0.02), n_workers=1, api_name="shed",
+        fabric=shed_cfg, worker_timeout=5.0,
+    ) as srv:
+        tolerant_load(srv.port, "shed", 2, 3)  # warm
+        base_statuses, base_lat = tolerant_load(srv.port, "shed", 4, 15)
+        over_statuses, over_lat = tolerant_load(srv.port, "shed", 16, 15)
+        overload_stats = {
+            "baseline": stats(base_statuses, base_lat),
+            "overloaded": stats(over_statuses, over_lat),
+            "shed_429": sum(1 for s in over_statuses if s == 429),
+            "p99_ratio_vs_baseline": (
+                round(
+                    over_lat[int(len(over_lat) * 0.99)]
+                    / base_lat[int(len(base_lat) * 0.99)],
+                    3,
+                )
+                if over_lat and base_lat else None
+            ),
+        }
+
+    # -- scenario 4: hot swap under load, zero failures ------------------------
+    with DistributedServingServer(
+        echo_factory(), n_workers=4, api_name="swap", fabric=fast,
+        worker_timeout=2.0,
+    ) as srv:
+        tolerant_load(srv.port, "swap", 4, 4)  # warm
+        swap_ms = [None]
+
+        def maybe_swap(seq):
+            if seq == 60:
+                t0 = time.perf_counter()
+                srv.replace_worker(0)
+                swap_ms[0] = round((time.perf_counter() - t0) * 1e3, 1)
+
+        statuses, lat = tolerant_load(
+            srv.port, "swap", 6, 30, on_request=maybe_swap
+        )
+        swap_stats = stats(statuses, lat)
+        swap_stats["swap_ms"] = swap_ms[0]
+
+    report = {
+        "pr": 6,
+        "platform": jax.default_backend(),
+        "fault_tolerance": {
+            "kill_1_of_4": kill_stats,
+            "wedge_breaker": wedge_stats,
+            "overload_shed": overload_stats,
+            "replace_under_load": swap_stats,
+        },
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return report
+
+
 def main() -> int:
     from mmlspark_tpu.dnn import resnet20_cifar
 
@@ -996,5 +1218,6 @@ if __name__ == "__main__":
         print(json.dumps(run_smoke(), sort_keys=True))
         print(json.dumps(run_serving_smoke(), sort_keys=True))
         print(json.dumps(run_obs_overhead_smoke(), sort_keys=True))
+        print(json.dumps(run_fault_smoke(), sort_keys=True))
         sys.exit(0)
     sys.exit(main())

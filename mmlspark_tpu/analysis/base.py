@@ -30,6 +30,8 @@ RULES: Dict[str, str] = {
     "blocking-host-work-under-lock": "json.loads/json.dumps/parse_request/make_reply inside a model-lock critical section starves device dispatch",
     # monotonic-time family (monotonic_time.py)
     "non-monotonic-duration": "time.time() feeding a duration/deadline computation; use time.monotonic/perf_counter",
+    # net-timeout family (net_timeout.py)
+    "network-call-no-timeout": "HTTPConnection/socket.create_connection without timeout= blocks on a dead peer for the OS TCP default",
     # Params-contract family (params_contract.py)
     "param-converter": "simple Param declared without an explicit type converter",
     "param-doc": "stage or Param missing documentation",

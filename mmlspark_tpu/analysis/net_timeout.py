@@ -1,0 +1,94 @@
+"""Network-timeout rule: no blocking network call without a timeout.
+
+`network-call-no-timeout` flags construction of
+``http.client.HTTPConnection`` / ``HTTPSConnection`` and calls to
+``socket.create_connection`` that pass no ``timeout=`` — the exact bug the
+serving gateway shipped with: a wedged worker held a gateway thread for the
+OS TCP default (minutes) because its keep-alive connection was built
+without one. Every blocking network call in this framework must carry an
+explicit bound so a dead/wedged peer costs one configured timeout, not an
+unbounded stall (docs/serving.md "Fault tolerance").
+
+Positional timeouts count: ``HTTPConnection(host, port, 5.0)`` (third
+positional) and ``socket.create_connection(addr, 5.0)`` (second) are
+clean. Detection is lexical over Call nodes whose callee's trailing name
+matches (bare imported name or any attribute chain) — aliasing a
+constructor through a variable first (``cls = HTTPConnection; cls(h)``)
+is not followed; the one such site in-tree (io/http/clients.py) passes its
+timeout at the aliased call and stays clean by construction. A justified
+exception takes ``# graftcheck: ignore[network-call-no-timeout]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional
+
+from mmlspark_tpu.analysis.base import Finding
+
+_RULE = "network-call-no-timeout"
+#: callee trailing name -> index of the positional parameter that carries
+#: the timeout (so an explicit positional timeout is recognized as clean)
+_NET_CALLS = {
+    "HTTPConnection": 2,
+    "HTTPSConnection": 2,
+    "create_connection": 1,
+}
+
+
+def _callee_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _is_create_connection(func: ast.AST, name: str) -> bool:
+    """create_connection must come from socket (bare name or socket.*);
+    HTTPConnection/HTTPSConnection names are specific enough on their own."""
+    if name != "create_connection":
+        return True
+    if isinstance(func, ast.Name):
+        return True  # `from socket import create_connection`
+    return (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "socket"
+    )
+
+
+def check_net_timeout(
+    paths: Iterable[str], repo_root: Optional[str] = None
+) -> List[Finding]:
+    repo_root = repo_root or os.getcwd()
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, repo_root)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _callee_name(node.func)
+            if name not in _NET_CALLS or not _is_create_connection(
+                node.func, name
+            ):
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            if len(node.args) > _NET_CALLS[name]:
+                continue  # timeout passed positionally
+            if any(kw.arg is None for kw in node.keywords):
+                continue  # **kwargs splat may carry it; don't guess
+            findings.append(Finding(
+                _RULE, rel, node.lineno,
+                f"{name}(...) without a timeout blocks for the OS TCP "
+                "default when the peer is dead or wedged; pass timeout=",
+            ))
+    return findings

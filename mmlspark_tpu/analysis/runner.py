@@ -96,6 +96,10 @@ def run_all(
         from mmlspark_tpu.analysis.monotonic_time import check_monotonic_time
 
         findings += check_monotonic_time(package_files, repo_root=root)
+    if "network-call-no-timeout" in enabled:
+        from mmlspark_tpu.analysis.net_timeout import check_net_timeout
+
+        findings += check_net_timeout(package_files, repo_root=root)
     if enabled & _PARAM_RULES:
         from mmlspark_tpu.analysis.params_contract import check_params_contract
 

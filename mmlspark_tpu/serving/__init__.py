@@ -27,10 +27,24 @@ from mmlspark_tpu.serving.server import (
     serve_pipeline,
 )
 from mmlspark_tpu.serving.distributed import DistributedServingServer
+from mmlspark_tpu.serving.fabric import (
+    AdmissionController,
+    CircuitBreaker,
+    FabricConfig,
+    RetryBudget,
+    ServingFabric,
+)
+from mmlspark_tpu.serving.faults import FaultInjector
 
 __all__ = [
+    "AdmissionController",
+    "CircuitBreaker",
     "DistributedServingServer",
+    "FabricConfig",
+    "FaultInjector",
     "MALFORMED_COL",
+    "RetryBudget",
+    "ServingFabric",
     "PipelineServingHandler",
     "ServingServer",
     "StagedServingHandler",

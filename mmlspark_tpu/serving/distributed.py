@@ -1,46 +1,77 @@
-"""Distributed serving: a worker pool behind a routing gateway.
+"""Distributed serving: a worker pool behind a fault-tolerant routing gateway.
 
 Reference: io/http/src/main/scala/DistributedHTTPSource.scala:89-242 — one
 JVMSharedServer per executor, each binding its own port and scoring its own
 partition, with a driver-side gateway (PortForwarding.scala:12) fronting the
 pool — and HTTPSourceV2.scala:167-404's continuous per-partition commit (no
-cross-partition lock).
+cross-partition lock). The reference survives executor churn because the
+driver only routes to partitions that are alive; this gateway recreates
+that property without a driver through the serving fabric
+(serving/fabric.py):
 
-TPU re-design: the partition==executor mapping becomes worker==replica. Each
-worker owns a PRIVATE handler instance (its own compiled model, its own
-model lock), so continuous-mode scoring never serializes across workers —
-the exact fix for the single `_model_lock` bottleneck flagged in round 3.
-Workers are in-process threads sharing the chip: XLA executes their
-dispatches back-to-back, so concurrency hides host-side overhead (request
-parse, feature build, reply encode) behind device compute. Multi-host scale
-uses the same topology with workers on peer hosts and the router as the
-cross-host gateway.
+- **health-driven routing**: power-of-two-choices among workers that are
+  (a) green on their own PR 5 ``health()`` signal, (b) closed on their
+  circuit breaker, and (c) not draining; EWMA latency + in-flight counts
+  break the choice. A worker that fails at the transport level (connect
+  refused, read timeout) accumulates breaker failures and is ejected;
+  after ``open_secs`` single probe requests test it back in.
+- **retry + hedge**: a failed forward retries against a *different* worker
+  with full-jitter backoff, capped by a retry-budget token bucket so
+  retries can never amplify an overload; optional tail hedging duplicates
+  a request to a second worker once it outlives the observed p95.
+- **admission control + load shedding**: an AIMD concurrency limit at the
+  gateway edge; excess load fast-fails with 429 + Retry-After instead of
+  queueing toward the request timeout (`serving_shed_requests_total`).
+- **graceful drain / hot restart**: ``drain(idx)`` stops routing to a
+  worker and flushes its in-flight; ``replace_worker(idx)`` starts a
+  replacement first, drains, atomically swaps the slot, then tears the old
+  worker down — zero-downtime model refresh.
+
+TPU re-design: the partition==executor mapping becomes worker==replica.
+Each worker owns a PRIVATE handler instance (its own compiled model, its
+own model lock), so continuous-mode scoring never serializes across
+workers. Workers are in-process threads sharing the chip; multi-host scale
+uses the same topology with workers on peer hosts and this gateway as the
+cross-host router — which is exactly why the fabric treats workers as
+opaque HTTP peers that can die, wedge, or lag.
 """
 
 from __future__ import annotations
 
 import http.client
 import http.server
-import itertools
 import json
 import socket
 import threading
-from typing import Callable, List, Optional
+import time
+from typing import Callable, List, Optional, Tuple
 
 from mmlspark_tpu.core.config import get_logger
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.obs import registry as obs_registry
+from mmlspark_tpu.serving.fabric import FabricConfig, ServingFabric
+from mmlspark_tpu.serving.faults import FaultInjector
 from mmlspark_tpu.serving.server import ServingServer
 
 log = get_logger("mmlspark_tpu.serving")
 
+#: (status, reason, content-type, payload) of one forwarded exchange
+_Result = Tuple[int, str, Optional[str], bytes]
+
 
 class DistributedServingServer:
-    """N ServingServer workers + a routing gateway on one public port.
+    """N ServingServer workers + a fault-tolerant routing gateway on one
+    public port.
 
     handler_factory() is called once PER WORKER so each worker holds its own
     handler state (compiled model replica, locks). Pass a plain handler only
     if it is stateless/thread-safe.
+
+    `fabric` tunes routing/retry/admission (serving/fabric.py FabricConfig);
+    `worker_timeout` bounds every gateway->worker exchange (connect AND
+    read) so a wedged worker costs one bounded timeout, not an OS-default
+    TCP stall; `fault_injector` wires in the deterministic fault harness
+    (serving/faults.py) for tests and the fault smoke bench.
     """
 
     def __init__(
@@ -56,31 +87,61 @@ class DistributedServingServer:
         request_timeout: float = 30.0,
         engine: str = "pipelined",
         in_flight_depth: int = 2,
+        fabric: Optional[FabricConfig] = None,
+        worker_timeout: Optional[float] = None,
+        fault_injector: Optional[FaultInjector] = None,
     ):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
         self.host = host
         self.api_name = api_name
         self._port = port
+        self.handler_factory = handler_factory
+        self.worker_timeout = (
+            worker_timeout if worker_timeout is not None
+            else request_timeout + 5.0
+        )
+        self._worker_kwargs = dict(
+            host=host,
+            port=0,
+            api_name=api_name,
+            mode=mode,
+            max_batch_size=max_batch_size,
+            max_wait_ms=max_wait_ms,
+            request_timeout=request_timeout,
+            engine=engine,
+            in_flight_depth=in_flight_depth,
+        )
         self.workers: List[ServingServer] = [
-            ServingServer(
-                handler_factory(),
-                host=host,
-                port=0,
-                api_name=api_name,
-                mode=mode,
-                max_batch_size=max_batch_size,
-                max_wait_ms=max_wait_ms,
-                request_timeout=request_timeout,
-                engine=engine,
-                in_flight_depth=in_flight_depth,
-            )
-            for _ in range(n_workers)
+            self._make_worker() for _ in range(n_workers)
         ]
-        self._rr = itertools.count()
-        self._httpd: Optional[http.server.ThreadingHTTPServer] = None
-        # keep-alive connections to workers, one per (gateway thread, worker)
+        self.fabric = ServingFabric(
+            n_workers,
+            config=fabric,
+            health_fns=[self._health_fn(w) for w in self.workers],
+            gateway_label=f"{api_name}-gw",
+        )
+        self._faults = fault_injector
+        # keep-alive connections to workers, one per (gateway thread, worker);
+        # the generation counter invalidates every thread's cached connection
+        # to a slot when replace_worker swaps it
         self._local = threading.local()
+        self._conn_gen: List[int] = [0] * n_workers
+        self._hedge_pool = None
+        self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+        self._stopping = threading.Event()
+        self._replace_lock = threading.Lock()
+
+    def _make_worker(
+        self, factory: Optional[Callable] = None
+    ) -> ServingServer:
+        return ServingServer(
+            (factory or self.handler_factory)(), **self._worker_kwargs
+        )
+
+    @staticmethod
+    def _health_fn(worker: ServingServer) -> Callable[[], bool]:
+        return lambda: worker.health()[0]
 
     @property
     def port(self) -> int:
@@ -90,42 +151,246 @@ class DistributedServingServer:
     def url(self) -> str:
         return f"http://{self.host}:{self._port}/{self.api_name}"
 
-    # -- gateway ---------------------------------------------------------------
+    def inject_faults(self, injector: FaultInjector) -> FaultInjector:
+        self._faults = injector
+        return injector
+
+    # -- gateway -> worker transport -------------------------------------------
 
     def _worker_conn(self, idx: int) -> http.client.HTTPConnection:
         conns = getattr(self._local, "conns", None)
         if conns is None:
             conns = self._local.conns = {}
-        conn = conns.get(idx)
-        if conn is None:
-            conn = http.client.HTTPConnection(
-                self.workers[idx].host, self.workers[idx].port
-            )
-            conn.connect()
-            # small writes both ways: Nagle + delayed ACK would add ~40 ms
-            # per forwarded exchange (same fix as ServingServer's handler)
-            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            conns[idx] = conn
+        gen = self._conn_gen[idx]
+        entry = conns.get(idx)
+        if entry is not None:
+            if entry[0] == gen:
+                return entry[1]
+            entry[1].close()  # slot was replaced: stale connection
+        conn = http.client.HTTPConnection(
+            self.workers[idx].host, self.workers[idx].port,
+            timeout=self.worker_timeout,
+        )
+        conn.connect()
+        # small writes both ways: Nagle + delayed ACK would add ~40 ms
+        # per forwarded exchange (same fix as ServingServer's handler)
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conns[idx] = (gen, conn)
         return conn
 
-    def _forward(self, idx: int, method: str, path: str, body: bytes,
-                 content_type: str):
-        conn = self._worker_conn(idx)
+    def _drop_conn(self, idx: int) -> None:
+        conns = getattr(self._local, "conns", None)
+        if conns:
+            entry = conns.pop(idx, None)
+            if entry is not None:
+                entry[1].close()
+
+    def _attempt(self, idx: int, method: str, path: str, body: bytes,
+                 content_type: Optional[str]) -> _Result:
+        """One forward to worker idx over the cached keep-alive connection.
+
+        A stale keep-alive (the worker closed an idle connection) rebuilds
+        and retries ONCE against the same worker — but, unlike the old
+        gateway, the staleness is reported to the router as a failure
+        signal first, so a worker that keeps dropping connections
+        accumulates breaker failures instead of being silently retried
+        forever. Timeouts are NOT retried here: a wedged worker won't
+        answer a fresh connection either — surface to the failover policy.
+        """
+        if self._faults is not None:
+            self._faults.intercept(idx, self.worker_timeout)
         headers = {"Content-Type": content_type or "application/json"}
         try:
-            conn.request(method, path, body=body, headers=headers)
-            return conn.getresponse()
-        except (http.client.HTTPException, ConnectionError, OSError):
-            # stale keep-alive: rebuild once and retry
-            conn.close()
-            self._local.conns.pop(idx, None)
             conn = self._worker_conn(idx)
             conn.request(method, path, body=body, headers=headers)
-            return conn.getresponse()
+            resp = conn.getresponse()
+            payload = resp.read()
+        except (http.client.HTTPException, ConnectionError, OSError) as e:
+            self._drop_conn(idx)
+            if isinstance(e, socket.timeout):
+                raise
+            # soft signal: counted and visible, but only the hard path
+            # (the rebuild failing too) feeds the breaker — a single stale
+            # blip whose retry succeeds must not eject the worker
+            self.fabric.record_failure(idx, kind="stale_conn", breaker=False)
+            try:
+                conn = self._worker_conn(idx)
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+            except (http.client.HTTPException, ConnectionError, OSError):
+                # the rebuild failed too (worker dying mid-exchange):
+                # don't leave the broken conn cached for this thread, or
+                # every later forward pays a spurious stale_conn signal
+                # plus a dead round-trip before rebuilding
+                self._drop_conn(idx)
+                raise
+        return resp.status, resp.reason, resp.getheader("Content-Type"), payload
+
+    # -- routing policy --------------------------------------------------------
+
+    def _route_once(self, method: str, path: str, body: bytes,
+                    content_type: Optional[str],
+                    exclude: Tuple[int, ...]) -> Tuple[Optional[_Result], Optional[int]]:
+        """One routed attempt: pick a worker, forward, feed the router.
+        Returns (result, worker_idx); result is None on transport failure
+        (the failure is already recorded), worker_idx is None when nothing
+        was routable."""
+        picked = self.fabric.pick_and_acquire(exclude)
+        if picked is None and exclude:
+            # every routable worker already failed this request; retrying
+            # one beats an instant 502 (it may have been a stale conn blip)
+            picked = self.fabric.pick_and_acquire(())
+        if picked is None:
+            return None, None
+        idx, _probe = picked
+        t0 = time.monotonic()
+        try:
+            result = self._attempt(idx, method, path, body, content_type)
+        except (http.client.HTTPException, ConnectionError, OSError) as e:
+            self.fabric.release(idx)
+            self.fabric.record_failure(idx)
+            log.warning("worker %d failed: %r", idx, e)
+            return None, idx
+        self.fabric.release(idx)
+        latency_ms = (time.monotonic() - t0) * 1e3
+        if result[0] == 503:
+            # the worker itself is shedding/stopping: a failure signal for
+            # the router AND grounds to fail over, same as a transport error
+            self.fabric.record_failure(idx, kind="worker_503")
+            return result, idx
+        self.fabric.record_success(idx, latency_ms)
+        return result, idx
+
+    def _route_and_forward(self, method: str, path: str, body: bytes,
+                           content_type: Optional[str]) -> _Result:
+        """Forward with failover: budgeted retries against different
+        workers with full-jitter backoff. Exhausted budget/attempts surface
+        the last worker answer (a 503) or a 502."""
+        cfg = self.fabric.config
+        exclude: List[int] = []
+        last_result: Optional[_Result] = None
+        attempt = 0
+        while True:
+            result, idx = self._route_once(
+                method, path, body, content_type, tuple(exclude)
+            )
+            if idx is None:
+                self.fabric.shed("no_healthy_workers")
+                return (
+                    503, "Service Unavailable", "application/json",
+                    b'{"error": "no healthy workers"}',
+                )
+            if result is not None and result[0] != 503:
+                return result
+            last_result = result or last_result
+            exclude.append(idx)
+            attempt += 1
+            if attempt > cfg.max_retries or not self.fabric.try_retry():
+                break
+            time.sleep(self.fabric.backoff_s(attempt))
+        if last_result is not None:
+            return last_result
+        return (
+            502, "Bad Gateway", "application/json",
+            b'{"error": "bad gateway: worker unreachable"}',
+        )
+
+    def _forward_api(self, method: str, path: str, body: bytes,
+                     content_type: Optional[str]) -> _Result:
+        """The api-route entry: plain failover, or tail-hedged failover
+        when the fabric config enables hedging."""
+        if self._hedge_pool is None:
+            return self._route_and_forward(method, path, body, content_type)
+        import concurrent.futures as cf
+
+        primary = self._hedge_pool.submit(
+            self._route_and_forward, method, path, body, content_type
+        )
+        done, _ = cf.wait([primary], timeout=self.fabric.hedge_delay_s())
+        if done or not self.fabric.try_retry(kind="hedge"):
+            return primary.result()
+        hedge = self._hedge_pool.submit(
+            self._route_and_forward, method, path, body, content_type
+        )
+        for fut in cf.as_completed([primary, hedge]):
+            result = fut.result()
+            if result[0] < 500:
+                return result
+        return result  # both 5xx: surface the last
+
+    # -- drain / hot restart ---------------------------------------------------
+
+    def drain(self, worker_idx: int, timeout: Optional[float] = None) -> bool:
+        """Stop routing new work to worker_idx and wait for its in-flight
+        (as seen by the gateway) to flush. Returns True when fully drained.
+        The slot stays unroutable until `undrain`/`replace_worker`."""
+        self.fabric.set_draining(worker_idx, True)
+        return self.fabric.wait_drained(worker_idx, timeout)
+
+    def undrain(self, worker_idx: int) -> None:
+        self.fabric.set_draining(worker_idx, False)
+
+    def replace_worker(
+        self,
+        worker_idx: int,
+        handler_factory: Optional[Callable] = None,
+        timeout: Optional[float] = None,
+    ) -> ServingServer:
+        """Zero-downtime hot swap of one worker slot: start the
+        replacement FIRST (compile warm-up happens off the serving path),
+        drain the incumbent, atomically install the replacement (fresh
+        breaker/EWMA state, every thread's cached connection invalidated),
+        then tear the incumbent down. Other workers carry the load during
+        the drain window, so with n_workers >= 2 no request ever fails."""
+        with self._replace_lock:
+            replacement = self._make_worker(handler_factory)
+            replacement.start()
+            self.fabric.set_draining(worker_idx, True)
+            drained = self.fabric.wait_drained(worker_idx, timeout)
+            if not drained:
+                log.warning(
+                    "worker %d did not drain in time; swapping anyway",
+                    worker_idx,
+                )
+            old = self.workers[worker_idx]
+            self.workers[worker_idx] = replacement
+            self._conn_gen[worker_idx] += 1
+            if self._faults is not None:
+                # injected faults are keyed by slot; the replacement must
+                # not inherit the incumbent's kill/wedge poison (this is
+                # how a killed worker comes back: replace, not heal)
+                self._faults.heal(worker_idx)
+            self.fabric.reset_worker(
+                worker_idx, health_fn=self._health_fn(replacement)
+            )
+            old.stop()
+            log.info(
+                "worker %d hot-swapped (port %s -> %s)",
+                worker_idx, old.port, replacement.port,
+            )
+            return replacement
+
+    # -- the gateway server ----------------------------------------------------
 
     def start(self) -> "DistributedServingServer":
         for w in self.workers:
             w.start()
+        if self.fabric.config.hedge:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # sized to the admission ceiling, not the worker count: every
+            # hedged request holds a pool thread for its primary (the pool
+            # is what races primary vs hedge — an inline primary would pin
+            # the handler thread for a wedged worker's full timeout even
+            # after the hedge answered), so a small pool would cap gateway
+            # concurrency below the admission limit and queue primaries.
+            # Threads spawn on demand; real concurrency is bounded by
+            # admission control, not this ceiling.
+            self._hedge_pool = ThreadPoolExecutor(
+                max_workers=2 * int(self.fabric.config.admission_max),
+                thread_name_prefix=f"gw-hedge-{self.api_name}",
+            )
         outer = self
 
         class Gateway(http.server.BaseHTTPRequestHandler):
@@ -136,24 +401,27 @@ class DistributedServingServer:
                 log.debug("gateway %s " + fmt, self.address_string(), *args)
 
             def _send_body(self, code: int, reason: str, payload: bytes,
-                           content_type: str) -> None:
+                           content_type: str,
+                           extra_headers: Tuple[Tuple[str, str], ...] = ()) -> None:
                 self.send_response(code, reason)
                 self.send_header("Content-Type", content_type)
+                for name, value in extra_headers:
+                    self.send_header(name, value)
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
 
             def do_POST(self):
                 route = self.path.split("?", 1)[0].rstrip("/")
+                # drain the body FIRST, on every route: on a keep-alive
+                # connection unread bytes would be parsed as the next
+                # request line, corrupting the connection (this includes
+                # the 404 and error reply paths, which used to skip it)
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
                 # observability surfaces: workers share this process, so
                 # the gateway serves the shared registry directly and
                 # aggregates per-worker liveness (docs/observability.md)
-                if route in ("/metrics", "/healthz"):
-                    # drain any body first: on a keep-alive connection
-                    # unread bytes would corrupt the next request
-                    n = int(self.headers.get("Content-Length") or 0)
-                    if n:
-                        self.rfile.read(n)
                 if route == "/metrics":
                     self._send_body(
                         200, "OK",
@@ -162,16 +430,10 @@ class DistributedServingServer:
                     )
                     return
                 if route == "/healthz":
-                    healths = [w.health() for w in outer.workers]
-                    ok = all(h[0] for h in healths)
-                    body = json.dumps({
-                        "status": "ok" if ok else "degraded",
-                        "workers": [h[1] for h in healths],
-                    }, sort_keys=True).encode("utf-8")
+                    code, payload = outer._healthz()
                     self._send_body(
-                        200 if ok else 503,
-                        "OK" if ok else "Service Unavailable",
-                        body, "application/json",
+                        code, "OK" if code == 200 else "Service Unavailable",
+                        payload, "application/json",
                     )
                     return
                 if route != f"/{outer.api_name}":
@@ -179,41 +441,57 @@ class DistributedServingServer:
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
-                n = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(n) if n else b""
-                idx = next(outer._rr) % len(outer.workers)
+                if outer._stopping.is_set():
+                    self._send_body(
+                        503, "Service Unavailable",
+                        b'{"error": "gateway stopping"}', "application/json",
+                    )
+                    return
+                # admission control: shed NOW rather than queue to death.
+                # admission.in_flight doubles as the gateway's in-flight
+                # meter (stop() waits on it).
+                if not outer.fabric.admission.try_acquire():
+                    outer.fabric.shed("admission")
+                    self._send_body(
+                        429, "Too Many Requests",
+                        b'{"error": "overloaded, retry later"}',
+                        "application/json",
+                        extra_headers=(("Retry-After", "1"),),
+                    )
+                    return
+                outer.fabric.fund_retry_budget()
+                t0 = time.monotonic()
                 try:
-                    resp = outer._forward(
-                        idx, self.command, self.path, body,
+                    status, reason, ct, payload = outer._forward_api(
+                        self.command, self.path, body,
                         self.headers.get("Content-Type"),
                     )
-                    payload = resp.read()
-                except Exception as e:  # dead worker: surface a 502
-                    log.warning("worker %d unreachable: %r", idx, e)
-                    msg = b'{"error": "bad gateway: worker unreachable"}'
-                    self.send_response(502, "Bad Gateway")
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(msg)))
-                    self.end_headers()
-                    self.wfile.write(msg)
-                    return
-                self.send_response(resp.status, resp.reason)
-                ct = resp.getheader("Content-Type")
-                if ct:
-                    self.send_header("Content-Type", ct)
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+                except Exception as e:  # defensive: policy must not 500 the gateway
+                    log.exception("gateway forward failed")
+                    status, reason = 502, "Bad Gateway"
+                    ct = "application/json"
+                    payload = json.dumps(
+                        {"error": f"bad gateway: {e!r}"}
+                    ).encode("utf-8")
+                latency_ms = (time.monotonic() - t0) * 1e3
+                outer.fabric.admission.release(
+                    latency_ms, overloaded=status in (502, 503)
+                )
+                self._send_body(status, reason, payload,
+                                ct or "application/json")
 
             do_GET = do_POST
             do_PUT = do_POST
 
-        self._httpd = http.server.ThreadingHTTPServer(
-            (self.host, self._port), Gateway
-        )
-        self._httpd.daemon_threads = True
+        from mmlspark_tpu.serving.server import _GatewayHTTPServer
+
+        self._httpd = _GatewayHTTPServer((self.host, self._port), Gateway)
         self._port = self._httpd.server_address[1]
-        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        httpd = self._httpd
+        threading.Thread(
+            target=lambda: httpd.serve_forever(poll_interval=0.05),
+            daemon=True,
+        ).start()
         log.info(
             "distributed serving %s -> %d workers (%s)",
             self.url, len(self.workers),
@@ -221,13 +499,54 @@ class DistributedServingServer:
         )
         return self
 
-    def stop(self) -> None:
+    def _healthz(self) -> Tuple[int, bytes]:
+        """Gateway liveness: 200 while at least one worker is routable (the
+        gateway can still serve — that is the whole point of the fabric),
+        503 when none are or the gateway is stopping. `status` grades it:
+        ok (everything green) / degraded (serving around failures) /
+        stopping / unavailable."""
+        healths = [w.health() for w in self.workers]
+        router = self.fabric.snapshot()
+        routable = [w for w in router["workers"] if w["healthy"]]
+        stopping = self._stopping.is_set()
+        if stopping:
+            status, code = "stopping", 503
+        elif not routable:
+            status, code = "unavailable", 503
+        elif len(routable) < len(self.workers) or not all(
+            h[0] for h in healths
+        ):
+            status, code = "degraded", 200
+        else:
+            status, code = "ok", 200
+        body = json.dumps({
+            "status": status,
+            "workers": [h[1] for h in healths],
+            "router": router,
+        }, sort_keys=True).encode("utf-8")
+        return code, body
+
+    def stop(self, drain_timeout: float = 10.0) -> None:
+        """Graceful stop: refuse new work (503), wait for in-flight gateway
+        requests to complete (bounded by drain_timeout), then tear down the
+        gateway, the workers, and the fabric's registry hooks."""
+        self._stopping.set()
+        deadline = time.monotonic() + drain_timeout
+        while (
+            self.fabric.admission.in_flight > 0
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._hedge_pool is not None:
+            self._hedge_pool.shutdown(wait=False)
+            self._hedge_pool = None
         for w in self.workers:
             w.stop()
+        self.fabric.close()
 
     def __enter__(self) -> "DistributedServingServer":
         return self.start()

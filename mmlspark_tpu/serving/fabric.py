@@ -1,0 +1,656 @@
+"""Fault-tolerant serving fabric: the gateway's routing/retry/admission brain.
+
+Reference: the Spark Serving gateway survives executor churn because the
+driver only routes to partitions that are alive (DistributedHTTPSource.scala
+keeps a per-partition server registry; PortForwarding.scala fronts it) — a
+dead executor simply stops being a routing target. Our worker pool needs the
+same property without a driver: the gateway itself must observe worker
+health and route around failures.
+
+This module is the policy layer `DistributedServingServer` routes through
+(serving/distributed.py). It is transport-agnostic — nothing here opens a
+socket — so every policy is unit-testable with a fake clock and the
+fault-injection harness (serving/faults.py) can exercise the whole state
+machine deterministically. Four cooperating pieces:
+
+- **HealthRouter** (inside `ServingFabric`): power-of-two-choices over the
+  healthy worker set. Candidate one comes from a rotation counter (so an
+  idle pool degenerates to exact round-robin — deterministic, and every
+  worker stays warm), candidate two is sampled; the pick is the lower
+  (in_flight, EWMA latency) score. Health is the AND of three signals: the
+  worker's own PR 5 ``health()`` (dead engine threads, stopping), the
+  circuit breaker (transport-level failures the in-process health can't
+  see), and the drain flag.
+- **CircuitBreaker**: per-worker closed -> open -> half-open. `failure_
+  threshold` consecutive transport failures open it (no routes); after
+  `open_secs` it admits ONE in-flight probe request at a time; `probe_
+  successes` consecutive probe wins close it, any probe loss re-opens.
+- **RetryBudget**: a token bucket funded by primary requests (`ratio`
+  tokens per request, capped) and spent by retries/hedges — the classic
+  guard against retry amplification: at most ~`ratio` of offered load can
+  become retry load, so retries can never turn an overload into a storm.
+- **AdmissionController**: an AIMD concurrency limit at the gateway edge.
+  Admissions above the limit shed immediately (429 + Retry-After) instead
+  of queueing toward the request timeout; completions grow the limit
+  additively (~+1 per `limit` completions), overload signals (worker
+  timeouts/503s, or latency above `latency_target_ms` when set) shrink it
+  multiplicatively, at most once per `adjust_interval_s`.
+
+Everything observable lands in the obs registry (docs/observability.md):
+`serving_shed_requests_total{reason}`, `serving_fabric_retries_total{kind}`,
+`serving_breaker_transitions_total{to}`, `serving_fabric_failures_total`,
+and a scrape-time `serving_admission_limit{gateway}` gauge; `snapshot()`
+is the router block `GET /healthz` serves.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.obs import registry as obs_registry
+from mmlspark_tpu.obs.metrics import QuantileSketch
+
+log = get_logger("mmlspark_tpu.serving")
+
+#: per-process fabric sequence — the `gateway` metric label must be unique
+#: per instance so two gateways in one process never merge their series
+_FABRIC_SEQ = itertools.count()
+
+
+@dataclass
+class FabricConfig:
+    """Tuning knobs for the serving fabric. Defaults are production-shaped:
+    generous admission ceiling (tests and small deployments never shed),
+    small failure threshold (a dead worker is ejected within a few
+    requests), sub-second probe cadence (recovery is fast)."""
+
+    # -- circuit breaker
+    failure_threshold: int = 3        # consecutive failures -> open
+    open_secs: float = 1.0            # open -> half-open delay
+    probe_successes: int = 1          # half-open probe wins -> closed
+    # -- retry / hedge
+    max_retries: int = 3              # attempts beyond the first, per request
+    retry_ratio: float = 0.1          # budget tokens funded per primary request
+    retry_budget_cap: float = 32.0    # token bucket ceiling
+    backoff_base_ms: float = 2.0      # full-jitter exponential base
+    backoff_max_ms: float = 50.0
+    hedge: bool = False               # tail hedging at p95
+    hedge_min_ms: float = 20.0        # never hedge earlier than this
+    # -- admission control (AIMD)
+    admission_initial: float = 64.0
+    admission_min: float = 2.0
+    admission_max: float = 1024.0
+    decrease_factor: float = 0.7      # multiplicative decrease on overload
+    adjust_interval_s: float = 0.1    # at most one decrease per interval
+    latency_target_ms: Optional[float] = None  # SLO; None = overload-only
+    # -- health cache
+    health_interval_s: float = 0.2    # min seconds between health() calls
+    # -- EWMA latency
+    ewma_alpha: float = 0.2
+    # -- drain
+    drain_timeout_s: float = 30.0
+    # deterministic jitter/sampling (None -> nondeterministic seeding)
+    seed: Optional[int] = 0
+
+
+class CircuitBreaker:
+    """closed -> open -> half-open per-worker breaker. Thread-safe; the
+    clock is injectable so tests drive transitions without sleeping."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        open_secs: float = 1.0,
+        probe_successes: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+        on_transition: Optional[Callable[[str], None]] = None,
+    ):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.open_secs = open_secs
+        self.probe_successes = probe_successes
+        self._clock = clock
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0          # consecutive, in closed state
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self._probe_wins = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _transition(self, to: str) -> None:
+        if self._state != to:
+            self._state = to
+            if self._on_transition is not None:
+                self._on_transition(to)
+
+    def _maybe_half_open(self) -> None:
+        # caller holds the lock
+        if (
+            self._state == self.OPEN
+            and self._clock() - self._opened_at >= self.open_secs
+        ):
+            self._transition(self.HALF_OPEN)
+            self._probe_in_flight = False
+            self._probe_wins = 0
+
+    def allows(self) -> bool:
+        """True when a normal request may route here (closed state only —
+        half-open traffic goes through `acquire_probe`)."""
+        with self._lock:
+            self._maybe_half_open()
+            return self._state == self.CLOSED
+
+    def acquire_probe(self) -> bool:
+        """Claim the single half-open probe slot. The caller MUST follow
+        with record_success/record_failure to release it."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state != self.HALF_OPEN or self._probe_in_flight:
+                return False
+            self._probe_in_flight = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                self._probe_in_flight = False
+                self._probe_wins += 1
+                if self._probe_wins >= self.probe_successes:
+                    self._transition(self.CLOSED)
+                    self._failures = 0
+            else:
+                self._failures = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == self.HALF_OPEN:
+                self._probe_in_flight = False
+                self._opened_at = self._clock()
+                self._transition(self.OPEN)
+            elif self._state == self.CLOSED:
+                self._failures += 1
+                if self._failures >= self.failure_threshold:
+                    self._opened_at = self._clock()
+                    self._transition(self.OPEN)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._transition(self.CLOSED)
+            self._failures = 0
+            self._probe_in_flight = False
+            self._probe_wins = 0
+
+
+class RetryBudget:
+    """Token bucket capping retry amplification: primary requests fund
+    `ratio` tokens each (up to `cap`), every retry/hedge spends one. Starts
+    full so cold-start failovers aren't starved."""
+
+    def __init__(self, ratio: float = 0.1, cap: float = 32.0):
+        self.ratio = ratio
+        self.cap = cap
+        self._lock = threading.Lock()
+        self._tokens = cap
+
+    def fund(self) -> None:
+        with self._lock:
+            self._tokens = min(self.cap, self._tokens + self.ratio)
+
+    def try_spend(self) -> bool:
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+
+class AdmissionController:
+    """AIMD gateway concurrency limit. `try_acquire` admits or sheds;
+    `release` feeds the control loop: overload signals (worker timeout/503,
+    or latency above the target when one is set) shrink the limit
+    multiplicatively — at most once per `adjust_interval_s`, so one slow
+    BATCH doesn't collapse the window — and clean completions grow it by
+    ~1 per `limit` completions (classic additive increase)."""
+
+    def __init__(
+        self,
+        initial: float = 64.0,
+        minimum: float = 2.0,
+        maximum: float = 1024.0,
+        decrease_factor: float = 0.7,
+        adjust_interval_s: float = 0.1,
+        latency_target_ms: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.minimum = minimum
+        self.maximum = maximum
+        self.decrease_factor = decrease_factor
+        self.adjust_interval_s = adjust_interval_s
+        self.latency_target_ms = latency_target_ms
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._limit = float(min(max(initial, minimum), maximum))
+        self._in_flight = 0
+        self._last_decrease = float("-inf")
+
+    @property
+    def limit(self) -> float:
+        with self._lock:
+            return self._limit
+
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return self._in_flight
+
+    def try_acquire(self) -> bool:
+        with self._lock:
+            if self._in_flight >= int(self._limit):
+                return False
+            self._in_flight += 1
+            return True
+
+    def release(self, latency_ms: float, overloaded: bool = False) -> None:
+        with self._lock:
+            self._in_flight = max(0, self._in_flight - 1)
+            slow = (
+                self.latency_target_ms is not None
+                and latency_ms > self.latency_target_ms
+            )
+            if overloaded or slow:
+                now = self._clock()
+                if now - self._last_decrease >= self.adjust_interval_s:
+                    self._last_decrease = now
+                    self._limit = max(
+                        self.minimum, self._limit * self.decrease_factor
+                    )
+            else:
+                self._limit = min(self.maximum, self._limit + 1.0 / self._limit)
+
+
+class _WorkerState:
+    """Router-side view of one worker slot: breaker, EWMA latency,
+    gateway-tracked in-flight, drain flag, lazily cached health()."""
+
+    __slots__ = (
+        "idx", "breaker", "ewma_ms", "in_flight", "draining",
+        "health_fn", "_health_ok", "_health_at", "failures_total",
+        "unroutable_at",
+    )
+
+    def __init__(self, idx: int, breaker: CircuitBreaker,
+                 health_fn: Optional[Callable[[], bool]]):
+        self.idx = idx
+        self.breaker = breaker
+        self.ewma_ms: Optional[float] = None
+        self.in_flight = 0
+        self.draining = False
+        self.health_fn = health_fn
+        self._health_ok = True
+        self._health_at = float("-inf")
+        self.failures_total = 0
+        # when the router FIRST observed this worker unroutable (health
+        # flip or breaker open) — the "routing recovered in X ms" clock
+        self.unroutable_at: Optional[float] = None
+
+
+class ServingFabric:
+    """Router + retry budget + admission control, shared by every gateway
+    thread. All mutation happens under one small lock; the expensive bits
+    (worker health() calls) are rate-limited by `health_interval_s`."""
+
+    def __init__(
+        self,
+        n_workers: int,
+        config: Optional[FabricConfig] = None,
+        health_fns: Optional[Sequence[Optional[Callable[[], bool]]]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        gateway_label: Optional[str] = None,
+    ):
+        if n_workers < 1:
+            raise ValueError("n_workers must be >= 1")
+        self.config = config or FabricConfig()
+        cfg = self.config
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._rng = random.Random(cfg.seed)
+        self._rotation = itertools.count()
+        # unique per instance (like ServingServer's engine label): two
+        # gateways sharing an api_name must never merge their series
+        self.gateway_label = (
+            f"{gateway_label or 'gateway'}-{next(_FABRIC_SEQ)}"
+        )
+        reg = obs_registry()
+        self._shed_total = reg.counter(
+            "serving_shed_requests_total",
+            "Requests shed at the gateway edge instead of queued",
+            ("gateway", "reason"),
+        )
+        self._retries_total = reg.counter(
+            "serving_fabric_retries_total",
+            "Gateway retry/hedge attempts against a different worker",
+            ("gateway", "kind"),
+        )
+        self._failures_total = reg.counter(
+            "serving_fabric_failures_total",
+            "Transport-level worker failures observed by the gateway",
+            ("gateway", "kind"),
+        )
+        self._transitions = reg.counter(
+            "serving_breaker_transitions_total",
+            "Circuit-breaker state transitions across the worker pool",
+            ("gateway", "to"),
+        )
+        self._limit_gauge = reg.gauge(
+            "serving_admission_limit",
+            "Current AIMD admission concurrency limit at the gateway",
+            ("gateway",),
+        )
+        self._limit_gauge.labels(gateway=self.gateway_label).set_function(
+            lambda: self.admission.limit
+        )
+        self.admission = AdmissionController(
+            initial=cfg.admission_initial,
+            minimum=cfg.admission_min,
+            maximum=cfg.admission_max,
+            decrease_factor=cfg.decrease_factor,
+            adjust_interval_s=cfg.adjust_interval_s,
+            latency_target_ms=cfg.latency_target_ms,
+            clock=clock,
+        )
+        self.retry_budget = RetryBudget(cfg.retry_ratio, cfg.retry_budget_cap)
+        self._lat_sketch = QuantileSketch()
+        health_fns = health_fns or [None] * n_workers
+        self._workers = [
+            _WorkerState(i, self._make_breaker(), health_fns[i])
+            for i in range(n_workers)
+        ]
+
+    def _make_breaker(self) -> CircuitBreaker:
+        cfg = self.config
+        return CircuitBreaker(
+            cfg.failure_threshold, cfg.open_secs, cfg.probe_successes,
+            clock=self._clock,
+            on_transition=lambda to: self._transitions.labels(
+                gateway=self.gateway_label, to=to
+            ).inc(),
+        )
+
+    # -- health ----------------------------------------------------------------
+
+    def _health_ok(self, w: _WorkerState) -> bool:
+        """Cached worker health(), refreshed at most every
+        health_interval_s. The in-process health signal catches dead engine
+        threads and stopping servers; the breaker catches transport-level
+        wedges the in-process view can't see."""
+        if w.health_fn is None:
+            return True
+        now = self._clock()
+        if now - w._health_at >= self.config.health_interval_s:
+            w._health_at = now
+            try:
+                w._health_ok = bool(w.health_fn())
+            except Exception as e:  # a dead health probe IS unhealthiness
+                log.debug("worker %d health probe failed: %r", w.idx, e)
+                w._health_ok = False
+            if not w._health_ok and w.unroutable_at is None:
+                w.unroutable_at = now
+        return w._health_ok
+
+    # -- routing ---------------------------------------------------------------
+
+    @staticmethod
+    def _better(cand: _WorkerState, base: _WorkerState) -> bool:
+        """Is `cand` strictly the better pick? Fewer in-flight wins; on a
+        tie, EWMA diverts only when decisively (2x) faster — a strict
+        EWMA comparison would herd ALL idle traffic onto whichever worker
+        happens to be microseconds ahead, starving the rest (and starving
+        the breaker of the probe traffic it needs to observe recovery)."""
+        if cand.in_flight != base.in_flight:
+            return cand.in_flight < base.in_flight
+        if cand.ewma_ms is not None and base.ewma_ms is not None:
+            return cand.ewma_ms * 2.0 < base.ewma_ms
+        return False
+
+    def pick_and_acquire(
+        self, exclude: Sequence[int] = (), probe_ok: bool = True
+    ) -> Optional[Tuple[int, bool]]:
+        """Choose a worker and reserve one in-flight slot on it atomically
+        (so drain() never races an about-to-enter request). Returns
+        (worker_idx, is_probe) or None when nothing is routable.
+
+        Selection is power-of-two-choices over the healthy set: candidate
+        one rotates deterministically (idle pool == round-robin, every
+        worker exercised), candidate two is sampled; fewer in-flight wins,
+        with EWMA diverting a tie only on a decisive (2x) latency gap,
+        ties to the rotation candidate. A half-open breaker's single probe
+        slot is claimed opportunistically so recovered workers rejoin
+        without a side channel."""
+        excluded = set(exclude)
+        with self._lock:
+            # opportunistic half-open probe (one in flight per breaker)
+            if probe_ok:
+                for w in self._workers:
+                    if (
+                        w.idx not in excluded
+                        and not w.draining
+                        and self._health_ok(w)
+                        and w.breaker.acquire_probe()
+                    ):
+                        w.in_flight += 1
+                        return w.idx, True
+            healthy = [
+                w for w in self._workers
+                if w.idx not in excluded
+                and not w.draining
+                and w.breaker.allows()
+                and self._health_ok(w)
+            ]
+            if not healthy:
+                return None
+            if len(healthy) == 1:
+                chosen = healthy[0]
+            else:
+                c1 = healthy[next(self._rotation) % len(healthy)]
+                c2 = self._rng.choice([w for w in healthy if w is not c1])
+                chosen = c2 if self._better(c2, c1) else c1
+            chosen.in_flight += 1
+            return chosen.idx, False
+
+    def release(self, idx: int) -> None:
+        with self._lock:
+            w = self._workers[idx]
+            w.in_flight = max(0, w.in_flight - 1)
+
+    def record_success(self, idx: int, latency_ms: float) -> None:
+        """A completed forward: feeds the EWMA, the hedge-trigger sketch,
+        and the breaker (which internally credits half-open probes)."""
+        with self._lock:
+            w = self._workers[idx]
+            alpha = self.config.ewma_alpha
+            w.ewma_ms = (
+                latency_ms if w.ewma_ms is None
+                else alpha * latency_ms + (1 - alpha) * w.ewma_ms
+            )
+            self._lat_sketch.add(latency_ms)
+            w.breaker.record_success()
+            if w.breaker.state == CircuitBreaker.CLOSED and w._health_ok:
+                w.unroutable_at = None
+
+    def record_failure(self, idx: int, kind: str = "transport",
+                       breaker: bool = True) -> None:
+        """A transport-level failure (connect refused, read timeout, worker
+        503): counted per kind in `serving_fabric_failures_total`, and fed
+        to the breaker so repeated failures eject the worker. `breaker=
+        False` records a SOFT signal (counted, visible in /healthz) without
+        breaker consequences — the stale-keep-alive rebuild uses it: a
+        single stale blip whose same-worker retry succeeds must not eject a
+        provably-serving worker, while a rebuild that fails too comes back
+        through the hard path."""
+        self._failures_total.labels(
+            gateway=self.gateway_label, kind=kind
+        ).inc()
+        with self._lock:
+            w = self._workers[idx]
+            w.failures_total += 1
+            if breaker:
+                w.breaker.record_failure()
+                if not w.breaker.allows() and w.unroutable_at is None:
+                    w.unroutable_at = self._clock()
+
+    def unroutable_since(self, idx: int) -> Optional[float]:
+        """Monotonic time at which the router first observed worker `idx`
+        unroutable (health flip or breaker open); None while routable.
+        (clock_kill -> unroutable_since) is the routing-recovery latency
+        the fault smoke bench gates on — measured from the router's own
+        observations, immune to measurement-thread scheduling."""
+        with self._lock:
+            return self._workers[idx].unroutable_at
+
+    def routable_workers(self) -> List[int]:
+        with self._lock:
+            return [
+                w.idx for w in self._workers
+                if not w.draining and w.breaker.allows() and self._health_ok(w)
+            ]
+
+    # -- retry / hedge ---------------------------------------------------------
+
+    def fund_retry_budget(self) -> None:
+        self.retry_budget.fund()
+
+    def try_retry(self, kind: str = "retry") -> bool:
+        """Spend one retry-budget token; counts the attempt when granted."""
+        if not self.retry_budget.try_spend():
+            return False
+        self._retries_total.labels(gateway=self.gateway_label, kind=kind).inc()
+        return True
+
+    def backoff_s(self, attempt: int) -> float:
+        """Full-jitter exponential backoff for attempt N (1-based)."""
+        cfg = self.config
+        cap = min(cfg.backoff_max_ms, cfg.backoff_base_ms * (2 ** (attempt - 1)))
+        return self._rng.uniform(0.0, cap) / 1e3
+
+    def hedge_delay_s(self) -> float:
+        """Observed p95 forward latency (floored at hedge_min_ms) — the
+        tail-hedging trigger. Reads the sketch under the fabric lock:
+        QuantileSketch itself is not thread-safe and record_success
+        mutates it concurrently."""
+        with self._lock:
+            p95 = self._lat_sketch.quantile(0.95)
+        if p95 != p95:  # NaN: no samples yet
+            p95 = 0.0
+        return max(self.config.hedge_min_ms, p95) / 1e3
+
+    # -- shedding --------------------------------------------------------------
+
+    def shed(self, reason: str) -> None:
+        self._shed_total.labels(
+            gateway=self.gateway_label, reason=reason
+        ).inc()
+
+    # -- drain / replace -------------------------------------------------------
+
+    def set_draining(self, idx: int, draining: bool) -> None:
+        with self._lock:
+            self._workers[idx].draining = draining
+
+    def worker_in_flight(self, idx: int) -> int:
+        with self._lock:
+            return self._workers[idx].in_flight
+
+    def wait_drained(self, idx: int, timeout: Optional[float] = None) -> bool:
+        """Block until the gateway has zero in-flight requests on worker
+        `idx` (drain flag must already be set so no new ones enter).
+        Deliberately wall-clock (time.monotonic, not the injectable test
+        clock): it sleeps real time between polls, so pairing its deadline
+        with a frozen fake clock would spin forever."""
+        deadline = time.monotonic() + (
+            timeout if timeout is not None else self.config.drain_timeout_s
+        )
+        while self.worker_in_flight(idx) > 0:
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.002)
+        return True
+
+    def reset_worker(
+        self, idx: int, health_fn: Optional[Callable[[], bool]] = None
+    ) -> None:
+        """Fresh state for a replaced worker slot: new breaker, no EWMA
+        history, drain flag cleared."""
+        with self._lock:
+            w = self._workers[idx]
+            w.breaker = self._make_breaker()
+            w.ewma_ms = None
+            w.draining = False
+            w.failures_total = 0
+            w.unroutable_at = None
+            if health_fn is not None:
+                w.health_fn = health_fn
+            w._health_at = float("-inf")
+            w._health_ok = True
+
+    # -- observability ---------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The router block `GET /healthz` serves (docs/observability.md)."""
+        with self._lock:
+            workers = [
+                {
+                    "idx": w.idx,
+                    "breaker": w.breaker.state,
+                    "draining": w.draining,
+                    "healthy": (
+                        not w.draining
+                        and w.breaker.allows()
+                        and self._health_ok(w)
+                    ),
+                    "in_flight": w.in_flight,
+                    "ewma_ms": (
+                        round(w.ewma_ms, 3) if w.ewma_ms is not None else None
+                    ),
+                    "failures_total": w.failures_total,
+                }
+                for w in self._workers
+            ]
+        return {
+            "workers": workers,
+            "admission": {
+                "limit": round(self.admission.limit, 2),
+                "in_flight": self.admission.in_flight,
+            },
+            "retry_budget_tokens": round(self.retry_budget.tokens, 2),
+        }
+
+    def close(self) -> None:
+        """Unhook scrape-time callbacks that close over this fabric — the
+        process registry must not pin stopped gateways. Cumulative counter
+        series (shed/retries/failures/transitions) stay, same policy as
+        ServingServer's engine-labelled series: they hold plain floats,
+        not object references, and Prometheus counters are supposed to
+        survive their source."""
+        self._limit_gauge.remove(gateway=self.gateway_label)

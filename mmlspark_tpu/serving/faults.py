@@ -1,0 +1,134 @@
+"""Fault injection for the serving fabric: kill/wedge/slow workers and
+drop/delay gateway<->worker connections, deterministically.
+
+The harness has two layers, matching where real faults happen:
+
+- **Worker faults**: `kill_worker` closes the worker's listening socket
+  (no drain, no goodbye — the moral equivalent of `kill -9` on a peer
+  host) AND poisons the gateway transport for that slot so established
+  keep-alive connections fail with ECONNREFUSED too — in-process workers'
+  per-connection threads outlive `server_close()`, so the poison is what
+  makes the kill behave like a dead remote host end to end. The worker
+  object stays around so tests can assert its engine state and
+  `DistributedServingServer.stop()` stays idempotent; a killed worker is
+  not resurrected by `heal` — use `replace_worker`.
+- **Transport faults** intercept the gateway's forward path
+  (`DistributedServingServer` consults `FaultInjector.intercept` before
+  each connection use): `wedge_worker` makes every forward block for the
+  gateway's per-worker timeout then raise the same `socket.timeout` a real
+  unresponsive peer produces; `slow_worker` delays forwards; `drop_
+  connections` fails the next N forwards with `ConnectionError`. These are
+  deterministic — no real socket needs to hang for the breaker/retry state
+  machine to be exercised — and the raised exception types are exactly the
+  ones the real transport produces, so the gateway code under test cannot
+  tell the difference.
+
+Used by tests/test_fabric_faults.py and bench.run_fault_smoke
+(BENCH_pr06.json): the acceptance gate "kill 1 of 4 workers under load ->
+error rate < 1%, recovery < 500 ms" runs through this harness.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from mmlspark_tpu.core.config import get_logger
+
+log = get_logger("mmlspark_tpu.serving")
+
+
+class FaultInjector:
+    """Deterministic fault state consulted by the gateway per forward.
+
+    One injector per DistributedServingServer (pass as `fault_injector=`
+    or call `server.inject_faults()`). Thread-safe: gateway handler threads
+    read the mode map under a lock; tests mutate it from outside."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # idx -> ("wedged", None) | ("slow", delay_s) | ("drop", n_left)
+        self._modes: Dict[int, Tuple[str, Optional[float]]] = {}
+
+    # -- worker faults ---------------------------------------------------------
+
+    def kill_worker(self, server: "object", idx: int) -> None:
+        """Kill worker idx: close its listening socket (new connections
+        refuse) AND poison the transport so the gateway's ESTABLISHED
+        keep-alive connections fail like a dead host's would. The second
+        half matters: ThreadingHTTPServer's per-connection threads outlive
+        server_close(), so without the transport poison a 'killed'
+        in-process worker would keep answering over cached connections —
+        masking the very failover path the kill is supposed to exercise.
+        The worker's health() flips to unhealthy immediately."""
+        worker = server.workers[idx]
+        httpd = worker._httpd
+        if httpd is not None:
+            worker._httpd = None  # health() reports not-started IMMEDIATELY
+            httpd.shutdown()
+            httpd.server_close()
+        with self._lock:
+            self._modes[idx] = ("dead", None)
+        log.info("fault: killed worker %d (port %s)", idx, worker.port)
+
+    # -- transport faults ------------------------------------------------------
+
+    def wedge_worker(self, idx: int) -> None:
+        """Every forward to idx blocks for the gateway's worker timeout and
+        then raises socket.timeout — an accepted-but-never-answered peer."""
+        with self._lock:
+            self._modes[idx] = ("wedged", None)
+
+    def slow_worker(self, idx: int, delay_s: float) -> None:
+        """Every forward to idx is delayed by delay_s, then proceeds."""
+        with self._lock:
+            self._modes[idx] = ("slow", float(delay_s))
+
+    def drop_connections(self, idx: int, n: int = 1) -> None:
+        """The next n forwards to idx fail with ConnectionError."""
+        with self._lock:
+            self._modes[idx] = ("drop", float(n))
+
+    def heal(self, idx: Optional[int] = None) -> None:
+        """Clear transport faults for one worker (or all)."""
+        with self._lock:
+            if idx is None:
+                self._modes.clear()
+            else:
+                self._modes.pop(idx, None)
+
+    def mode(self, idx: int) -> Optional[str]:
+        with self._lock:
+            entry = self._modes.get(idx)
+            return entry[0] if entry else None
+
+    # -- the gateway hook ------------------------------------------------------
+
+    def intercept(self, idx: int, worker_timeout: float) -> None:
+        """Called by the gateway before forwarding to worker idx. Raises
+        the fault's exception (the same types the real transport produces)
+        or returns after the configured delay."""
+        with self._lock:
+            entry = self._modes.get(idx)
+            if entry is None:
+                return
+            kind, arg = entry
+            if kind == "drop":
+                left = (arg or 0) - 1
+                if left <= 0:
+                    self._modes.pop(idx, None)
+                else:
+                    self._modes[idx] = ("drop", left)
+        if kind == "dead":
+            raise ConnectionRefusedError(
+                f"fault: worker {idx} is dead"
+            )
+        if kind == "drop":
+            raise ConnectionError(f"fault: dropped connection to worker {idx}")
+        if kind == "wedged":
+            time.sleep(worker_timeout)
+            raise socket.timeout(f"fault: worker {idx} wedged")
+        if kind == "slow":
+            time.sleep(arg or 0.0)

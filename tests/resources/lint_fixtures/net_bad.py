@@ -1,0 +1,27 @@
+"""Fixture for the network-call-no-timeout rule: blocking network calls
+constructed without a timeout bound. Parsed, never imported."""
+
+import http.client
+import socket
+from http.client import HTTPSConnection
+
+
+def bad_gateway_conn(host, port):
+    conn = http.client.HTTPConnection(host, port)  # expect[network-call-no-timeout]
+    tls = HTTPSConnection(host)  # expect[network-call-no-timeout]
+    raw = socket.create_connection((host, port))  # expect[network-call-no-timeout]
+    ctl = http.client.HTTPConnection(host)  # control-plane ping  # graftcheck: ignore[network-call-no-timeout]  # expect-suppressed[network-call-no-timeout]
+    return conn, tls, raw, ctl
+
+
+def fine_with_timeouts(host, port, opts):
+    a = http.client.HTTPConnection(host, port, timeout=5.0)  # clean: keyword
+    b = http.client.HTTPConnection(host, port, 5.0)  # clean: positional
+    c = socket.create_connection((host, port), 5.0)  # clean: positional
+    d = HTTPSConnection(host, timeout=2.0)  # clean: keyword
+    e = http.client.HTTPConnection(host, **opts)  # clean: splat may carry it
+    return a, b, c, d, e
+
+
+def not_a_network_call(pool):
+    return pool.create_connection()  # clean: not socket.create_connection

@@ -1,0 +1,568 @@
+"""Tests: the fault-tolerant serving fabric — circuit breaker, retry
+budget, AIMD admission control, health-driven power-of-two routing — and
+the gateway behaviors they enable under injected faults: worker kill with
+mid-request failover, wedge-trips-breaker, overload shedding, graceful
+drain / zero-downtime replace_worker, and the gateway-level observability
+surfaces the satellites call out (GET /metrics + /healthz under load,
+stop() with requests in flight, keep-alive 404 drain)."""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.serving import (
+    AdmissionController,
+    CircuitBreaker,
+    DistributedServingServer,
+    FabricConfig,
+    FaultInjector,
+    RetryBudget,
+    ServingFabric,
+    make_reply,
+    parse_request,
+)
+
+#: fast-converging knobs so fault tests settle in tens of milliseconds
+FAST = dict(
+    failure_threshold=2,
+    open_secs=0.2,
+    backoff_base_ms=1.0,
+    backoff_max_ms=5.0,
+    health_interval_s=0.05,
+)
+
+
+def _echo_factory(delay_s: float = 0.0):
+    """Each worker replies with x doubled (optionally after a delay)."""
+
+    def factory():
+        def handler(df: DataFrame) -> DataFrame:
+            if delay_s:
+                time.sleep(delay_s)
+            parsed = parse_request(df, {"x": None})
+            vals = np.asarray([float(v) * 2.0 for v in parsed["x"]])
+            return make_reply(
+                parsed.with_column("y", vals, DataType.DOUBLE), "y"
+            )
+
+        return handler
+
+    return factory
+
+
+def _post(port, api, payload, conn=None, timeout=30):
+    own = conn is None
+    if own:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request(
+        "POST", f"/{api}", body=json.dumps(payload),
+        headers={"Content-Type": "application/json"},
+    )
+    r = conn.getresponse()
+    body = r.read()
+    headers = dict(r.getheaders())
+    if own:
+        conn.close()
+    return r.status, body, headers
+
+
+def _get(port, route, timeout=10):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("GET", route)
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+# -- policy units -------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_full_state_machine_with_fake_clock(self):
+        t = [0.0]
+        b = CircuitBreaker(
+            failure_threshold=2, open_secs=1.0, probe_successes=2,
+            clock=lambda: t[0],
+        )
+        assert b.allows() and b.state == "closed"
+        b.record_failure()
+        assert b.allows()  # below threshold
+        b.record_failure()
+        assert b.state == "open" and not b.allows()
+        assert not b.acquire_probe()  # still open
+        t[0] = 1.1
+        assert b.state == "half_open"
+        assert b.acquire_probe()
+        assert not b.acquire_probe()  # single probe slot
+        b.record_success()
+        assert b.state == "half_open"  # needs 2 wins
+        assert b.acquire_probe()
+        b.record_failure()  # probe lost: re-open
+        assert b.state == "open"
+        t[0] = 2.2
+        assert b.acquire_probe()
+        b.record_success()
+        assert b.acquire_probe()
+        b.record_success()
+        assert b.state == "closed" and b.allows()
+
+    def test_success_resets_consecutive_failures(self):
+        b = CircuitBreaker(failure_threshold=2)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == "closed"  # never 2 consecutive
+
+
+class TestRetryBudget:
+    def test_tokens_fund_and_spend(self):
+        rb = RetryBudget(ratio=0.5, cap=2.0)
+        assert rb.try_spend() and rb.try_spend()
+        assert not rb.try_spend()  # bucket empty
+        rb.fund()
+        assert not rb.try_spend()  # 0.5 tokens < 1
+        rb.fund()
+        assert rb.try_spend()
+        assert not rb.try_spend()
+
+    def test_cap_bounds_amplification(self):
+        rb = RetryBudget(ratio=0.1, cap=3.0)
+        for _ in range(1000):
+            rb.fund()
+        assert rb.tokens == 3.0
+
+
+class TestAdmissionController:
+    def test_sheds_above_limit_and_aimd_adjusts(self):
+        t = [0.0]
+        ac = AdmissionController(
+            initial=4, minimum=2, maximum=8, decrease_factor=0.5,
+            adjust_interval_s=1.0, clock=lambda: t[0],
+        )
+        assert all(ac.try_acquire() for _ in range(4))
+        assert not ac.try_acquire()  # at the limit: shed
+        t[0] = 1.0
+        ac.release(10.0, overloaded=True)  # multiplicative decrease
+        assert ac.limit == pytest.approx(2.0)
+        ac.release(10.0, overloaded=True)  # within adjust interval: no-op
+        assert ac.limit == pytest.approx(2.0)
+        for _ in range(4):  # additive increase ~ 1 per `limit` completions
+            ac.release(10.0)
+        assert 3.0 < ac.limit < 5.0
+        assert ac.in_flight == 0
+
+    def test_latency_target_triggers_decrease(self):
+        ac = AdmissionController(
+            initial=8, minimum=2, maximum=8, adjust_interval_s=0.0,
+            latency_target_ms=50.0,
+        )
+        assert ac.try_acquire()
+        ac.release(200.0)  # over SLO
+        assert ac.limit < 8.0
+
+
+class TestHealthRouter:
+    def test_idle_pool_round_robins_deterministically(self):
+        fabric = ServingFabric(3, FabricConfig())
+        seen = []
+        for _ in range(9):
+            idx, probe = fabric.pick_and_acquire()
+            assert not probe
+            seen.append(idx)
+            fabric.release(idx)
+        assert sorted(set(seen)) == [0, 1, 2]
+        fabric.close()
+
+    def test_power_of_two_spreads_in_flight(self):
+        fabric = ServingFabric(3, FabricConfig())
+        for _ in range(6):  # hold every slot: no releases
+            fabric.pick_and_acquire()
+        loads = [w["in_flight"] for w in fabric.snapshot()["workers"]]
+        assert loads == [2, 2, 2]
+        fabric.close()
+
+    def test_draining_and_open_breakers_are_unroutable(self):
+        cfg = FabricConfig(failure_threshold=1)
+        fabric = ServingFabric(3, cfg)
+        fabric.set_draining(0, True)
+        fabric.record_failure(1)  # threshold 1: breaker opens
+        assert fabric.routable_workers() == [2]
+        for _ in range(5):
+            idx, _ = fabric.pick_and_acquire()
+            assert idx == 2
+            fabric.release(2)
+        fabric.close()
+
+    def test_unhealthy_worker_excluded_via_health_fn(self):
+        ok = [True, True]
+        fabric = ServingFabric(
+            2, FabricConfig(health_interval_s=0.0),
+            health_fns=[lambda: ok[0], lambda: ok[1]],
+        )
+        ok[0] = False
+        assert fabric.routable_workers() == [1]
+        ok[0] = True
+        assert fabric.routable_workers() == [0, 1]
+        fabric.close()
+
+    def test_snapshot_reports_router_state(self):
+        fabric = ServingFabric(2, FabricConfig())
+        fabric.record_success(0, 12.0)
+        snap = fabric.snapshot()
+        assert snap["workers"][0]["ewma_ms"] == pytest.approx(12.0)
+        assert snap["workers"][0]["breaker"] == "closed"
+        assert "limit" in snap["admission"]
+        assert snap["retry_budget_tokens"] > 0
+        fabric.close()
+
+
+# -- gateway under faults -----------------------------------------------------
+
+
+class TestFaultInjection:
+    def test_killed_worker_fails_over_with_no_client_errors(self):
+        faults = FaultInjector()
+        with DistributedServingServer(
+            _echo_factory(), n_workers=3, api_name="kill",
+            fabric=FabricConfig(**FAST), worker_timeout=2.0,
+            fault_injector=faults,
+        ) as srv:
+            for _ in range(6):  # warm every worker
+                assert _post(srv.port, "kill", {"x": 1.0})[0] == 200
+            faults.kill_worker(srv, 1)
+            statuses = [
+                _post(srv.port, "kill", {"x": 2.0})[0] for _ in range(30)
+            ]
+            assert statuses == [200] * 30  # failover absorbed the kill
+            _, body = _get(srv.port, "/healthz")
+            health = json.loads(body)
+            assert health["status"] == "degraded"
+            router = health["router"]["workers"]
+            assert not router[1]["healthy"]
+            assert router[0]["healthy"] and router[2]["healthy"]
+
+    def test_wedged_worker_trips_breaker_and_traffic_rebalances(self):
+        faults = FaultInjector()
+        with DistributedServingServer(
+            _echo_factory(), n_workers=2, api_name="wedge",
+            fabric=FabricConfig(**FAST), worker_timeout=0.3,
+            fault_injector=faults,
+        ) as srv:
+            for _ in range(4):
+                assert _post(srv.port, "wedge", {"x": 1.0})[0] == 200
+            faults.wedge_worker(0)
+            # early requests pay the worker_timeout then fail over; after
+            # failure_threshold of those the breaker ejects worker 0
+            for _ in range(4):
+                assert _post(srv.port, "wedge", {"x": 1.0})[0] == 200
+            snap = srv.fabric.snapshot()
+            assert snap["workers"][0]["breaker"] in ("open", "half_open")
+            # with the breaker open, requests no longer pay the wedge tax
+            # every time — at most ONE half-open probe per open_secs may
+            # still claim a request and pay one worker_timeout (0.3s)
+            t0 = time.perf_counter()
+            for _ in range(5):
+                assert _post(srv.port, "wedge", {"x": 1.0})[0] == 200
+            assert time.perf_counter() - t0 < 0.25 + 0.3 + 0.15
+            # heal: the half-open probe lets the worker rejoin
+            faults.heal(0)
+            time.sleep(FAST["open_secs"] + 0.05)
+            for _ in range(6):
+                assert _post(srv.port, "wedge", {"x": 1.0})[0] == 200
+            assert srv.fabric.snapshot()["workers"][0]["breaker"] == "closed"
+
+    def test_real_slow_worker_hits_read_timeout_and_fails_over(self):
+        """A genuinely unresponsive worker (handler slower than
+        worker_timeout) produces a real socket read timeout — not the
+        injector's simulated one — and the request still succeeds
+        elsewhere."""
+        calls = {"n": 0}
+
+        def factory():
+            slot = calls["n"]
+            calls["n"] += 1
+
+            def handler(df):
+                if slot == 0:
+                    time.sleep(0.8)  # beyond worker_timeout
+                parsed = parse_request(df, {"x": None})
+                return make_reply(
+                    parsed.with_column(
+                        "y", np.zeros(len(parsed)), DataType.DOUBLE
+                    ), "y",
+                )
+
+            return handler
+
+        with DistributedServingServer(
+            factory, n_workers=2, api_name="slow",
+            fabric=FabricConfig(**FAST), worker_timeout=0.3,
+        ) as srv:
+            t0 = time.perf_counter()
+            statuses = [_post(srv.port, "slow", {"x": 1})[0] for _ in range(4)]
+            assert statuses == [200] * 4
+            # worst case: one 0.3s timeout + failover, not 0.8s waits
+            assert time.perf_counter() - t0 < 2.0
+
+    def test_dropped_connections_are_failure_signals(self):
+        faults = FaultInjector()
+        with DistributedServingServer(
+            _echo_factory(), n_workers=2, api_name="drop",
+            fabric=FabricConfig(**FAST), fault_injector=faults,
+        ) as srv:
+            assert _post(srv.port, "drop", {"x": 1.0})[0] == 200
+            faults.drop_connections(0, n=4)
+            for _ in range(6):
+                assert _post(srv.port, "drop", {"x": 1.0})[0] == 200
+            assert srv.fabric.snapshot()["workers"][0]["failures_total"] >= 2
+
+    def test_overload_sheds_429_with_retry_after(self):
+        with DistributedServingServer(
+            _echo_factory(delay_s=0.1), n_workers=1, api_name="shed",
+            fabric=FabricConfig(
+                admission_initial=2, admission_min=2, admission_max=2,
+                **FAST,
+            ),
+        ) as srv:
+            results = []
+            lock = threading.Lock()
+
+            def client():
+                status, _, headers = _post(srv.port, "shed", {"x": 1.0})
+                with lock:
+                    results.append((status, headers.get("Retry-After")))
+
+            threads = [threading.Thread(target=client) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            codes = [s for s, _ in results]
+            assert codes.count(200) == 2  # the admitted window
+            assert codes.count(429) == 6  # everything else shed fast
+            assert all(ra == "1" for s, ra in results if s == 429)
+
+    def test_no_routable_worker_returns_503_not_hang(self):
+        faults = FaultInjector()
+        with DistributedServingServer(
+            _echo_factory(), n_workers=1, api_name="none",
+            fabric=FabricConfig(**FAST), worker_timeout=1.0,
+            fault_injector=faults,
+        ) as srv:
+            assert _post(srv.port, "none", {"x": 1.0})[0] == 200
+            faults.kill_worker(srv, 0)
+            time.sleep(FAST["health_interval_s"] + 0.05)
+            status, body, _ = _post(srv.port, "none", {"x": 1.0})
+            assert status in (502, 503)
+
+    def test_hedging_bounds_tail_latency(self):
+        faults = FaultInjector()
+        cfg = FabricConfig(hedge=True, hedge_min_ms=40.0, **FAST)
+        with DistributedServingServer(
+            _echo_factory(), n_workers=2, api_name="hedge",
+            fabric=cfg, worker_timeout=2.0, fault_injector=faults,
+        ) as srv:
+            for _ in range(4):
+                assert _post(srv.port, "hedge", {"x": 1.0})[0] == 200
+            faults.slow_worker(0, 0.6)
+            t0 = time.perf_counter()
+            status, body, _ = _post(srv.port, "hedge", {"x": 3.0})
+            dt = time.perf_counter() - t0
+            assert status == 200 and float(json.loads(body)) == 6.0
+            # without the hedge this pays the full 0.6s on worker 0
+            assert dt < 0.5, dt
+
+
+class TestDrainAndReplace:
+    def test_drain_stops_routing_and_undrain_restores(self):
+        with DistributedServingServer(
+            _echo_factory(), n_workers=2, api_name="drain",
+            fabric=FabricConfig(**FAST),
+        ) as srv:
+            assert srv.drain(0, timeout=2.0)
+            assert srv.fabric.routable_workers() == [1]
+            for _ in range(4):
+                assert _post(srv.port, "drain", {"x": 1.0})[0] == 200
+            srv.undrain(0)
+            assert srv.fabric.routable_workers() == [0, 1]
+
+    def test_replace_worker_under_load_zero_failures(self):
+        """The hot-swap acceptance: replace_worker() mid-load never fails a
+        request — the replacement starts first, the incumbent drains, the
+        slot swaps atomically."""
+        with DistributedServingServer(
+            _echo_factory(delay_s=0.005), n_workers=3, api_name="swap",
+            fabric=FabricConfig(**FAST),
+        ) as srv:
+            errors, lock, stop = [], threading.Lock(), threading.Event()
+
+            def client(cid):
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", srv.port, timeout=30
+                )
+                while not stop.is_set():
+                    status, body, _ = _post(
+                        srv.port, "swap", {"x": float(cid)}, conn
+                    )
+                    if status != 200:
+                        with lock:
+                            errors.append(status)
+                conn.close()
+
+            threads = [
+                threading.Thread(target=client, args=(i,)) for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.2)
+            old = srv.workers[1]
+            replacement = srv.replace_worker(1)
+            time.sleep(0.2)
+            stop.set()
+            for t in threads:
+                t.join()
+            assert errors == [], errors[:5]
+            assert srv.workers[1] is replacement and replacement is not old
+            assert old.port != replacement.port
+            assert not old.health()[0]  # incumbent fully stopped
+            # the fresh slot serves traffic again
+            assert srv.fabric.snapshot()["workers"][1]["breaker"] == "closed"
+            assert _post(srv.port, "swap", {"x": 1.0})[0] == 200
+
+    def test_replace_resurrects_killed_worker_slot(self):
+        """Killing then replacing a worker must leave the slot fully
+        routable: the injector's kill poison is keyed by slot, so the swap
+        has to clear it or the replacement inherits the dead transport
+        (regression — the docstring contract is 'a killed worker is not
+        resurrected by heal — use replace_worker')."""
+        faults = FaultInjector()
+        with DistributedServingServer(
+            _echo_factory(), n_workers=2, api_name="rez",
+            fabric=FabricConfig(**FAST), fault_injector=faults,
+        ) as srv:
+            faults.kill_worker(srv, 0)
+            # traffic survives on the peer; slot 0 accumulates failures
+            for _ in range(6):
+                assert _post(srv.port, "rez", {"x": 1.0})[0] == 200
+            assert faults.mode(0) == "dead"
+            srv.replace_worker(0)
+            assert faults.mode(0) is None  # poison cleared with the swap
+            # the replacement itself serves: drain the peer out of the
+            # pool so every request must route through slot 0
+            srv.drain(1)
+            for _ in range(4):
+                assert _post(srv.port, "rez", {"x": 2.0})[0] == 200
+            snap = srv.fabric.snapshot()["workers"][0]
+            assert snap["breaker"] == "closed" and snap["healthy"]
+
+
+# -- gateway observability + lifecycle (satellite coverage) -------------------
+
+
+class TestGatewaySurfaces:
+    def test_metrics_and_healthz_get_under_concurrent_load(self):
+        from mmlspark_tpu.obs.metrics import parse_prometheus
+
+        with DistributedServingServer(
+            _echo_factory(delay_s=0.002), n_workers=2, api_name="obs",
+            fabric=FabricConfig(**FAST),
+        ) as srv:
+            stop = threading.Event()
+
+            def load():
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", srv.port, timeout=30
+                )
+                while not stop.is_set():
+                    _post(srv.port, "obs", {"x": 1.0}, conn)
+                conn.close()
+
+            threads = [threading.Thread(target=load) for _ in range(3)]
+            for t in threads:
+                t.start()
+            try:
+                time.sleep(0.1)
+                for _ in range(5):  # scrape repeatedly mid-load
+                    status, body = _get(srv.port, "/metrics")
+                    assert status == 200
+                    samples = parse_prometheus(body.decode("utf-8"))
+                    names = {name for name, _ in samples}
+                    assert "serving_admission_limit" in names
+                    assert "serving_request_latency_ms_count" in names
+                    status, body = _get(srv.port, "/healthz")
+                    health = json.loads(body)
+                    assert status == 200 and health["status"] == "ok"
+                    router = health["router"]
+                    assert len(router["workers"]) == 2
+                    assert all(
+                        w["breaker"] == "closed" for w in router["workers"]
+                    )
+                    assert router["admission"]["limit"] > 0
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join()
+
+    def test_stop_with_requests_in_flight_completes_them(self):
+        srv = DistributedServingServer(
+            _echo_factory(delay_s=0.3), n_workers=2, api_name="stopping",
+            fabric=FabricConfig(**FAST),
+        ).start()
+        results, lock = [], threading.Lock()
+
+        def client():
+            status, body, _ = _post(
+                srv.port, "stopping", {"x": 2.0}, timeout=30
+            )
+            with lock:
+                results.append((status, body))
+
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)  # requests are mid-handler on the workers
+        srv.stop()
+        for t in threads:
+            t.join()
+        assert [s for s, _ in results] == [200] * 3
+        assert all(float(json.loads(b)) == 4.0 for _, b in results)
+        # fully stopped: the port no longer accepts
+        with pytest.raises(OSError):
+            _post(srv.port, "stopping", {"x": 1.0}, timeout=0.5)
+
+    def test_404_drains_body_keeping_keepalive_usable(self):
+        """Regression for the keep-alive desync: a 404 with an unread body
+        used to leave the body bytes in the stream, corrupting the next
+        request on the same connection."""
+        with DistributedServingServer(
+            _echo_factory(), n_workers=1, api_name="ka",
+            fabric=FabricConfig(**FAST),
+        ) as srv:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", srv.port, timeout=10
+            )
+            for _ in range(2):
+                status, _, _ = _post(srv.port, "nope", {"x": [1.0] * 64}, conn)
+                assert status == 404
+            status, body, _ = _post(srv.port, "ka", {"x": 21.0}, conn)
+            assert status == 200
+            assert float(json.loads(body)) == 42.0
+            conn.close()
+
+    def test_gateway_conns_have_timeouts(self):
+        """The gateway->worker connection must carry the configured bound
+        (the network-call-no-timeout rule enforces the code shape; this
+        checks the wired value)."""
+        with DistributedServingServer(
+            _echo_factory(), n_workers=1, api_name="to",
+            worker_timeout=7.5,
+        ) as srv:
+            assert _post(srv.port, "to", {"x": 1.0})[0] == 200
+            conn = srv._worker_conn(0)
+            assert conn.timeout == 7.5

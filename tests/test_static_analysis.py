@@ -222,6 +222,31 @@ def test_non_monotonic_rule_scopes_taint_per_function(tmp_path):
     assert check_monotonic_time([str(p)], repo_root=str(tmp_path)) == []
 
 
+# -- network timeouts ---------------------------------------------------------
+
+
+def test_network_call_no_timeout_fires_and_suppresses():
+    from mmlspark_tpu.analysis.net_timeout import check_net_timeout
+
+    path = os.path.join(FIXTURES, "net_bad.py")
+    findings = check_net_timeout([path], repo_root=FIXTURES)
+    _assert_matches_markers("net_bad.py", findings)
+
+
+def test_network_rule_allows_timeouts_and_unrelated_calls():
+    """Keyword and positional timeouts, **kwargs splats, and methods that
+    merely share the create_connection name must not be flagged."""
+    from mmlspark_tpu.analysis.net_timeout import check_net_timeout
+
+    path = os.path.join(FIXTURES, "net_bad.py")
+    findings = check_net_timeout([path], repo_root=FIXTURES)
+    with open(path) as f:
+        clean_lines = {
+            i for i, line in enumerate(f, start=1) if "clean" in line
+        }
+    assert not {f.line for f in findings} & clean_lines
+
+
 # -- schema flow --------------------------------------------------------------
 
 
