@@ -1166,6 +1166,267 @@ def run_fault_smoke(out_path: str = "BENCH_pr06.json") -> dict:
     return report
 
 
+def run_image_prep_smoke(out_path: str = "BENCH_pr07.json") -> dict:
+    """Image-dataplane smoke bench (CPU-safe small shapes; wired into
+    tier-1 via tests/test_bench_smoke.py), written to BENCH_pr07.json.
+
+    ISSUE 7 evidence, measured through the product path (no mocks):
+
+    - fused_prep: the fused device resize+unroll program
+      (images/device_ops.py, one upload + one XLA program) vs the pre-PR7
+      per-row host loop (`for img: ops.resize(img); transpose; reshape` —
+      the dataflow behind BENCH_r05's 279 imgs/sec). Gate: >= 2.5x (CI
+      scheduler-noise headroom under the ~10x typically measured; the
+      ISSUE's >= 3x acceptance is the e2e TPU-harness number).
+    - featurize_e2e: decode INCLUDED — a BINARY image column through
+      ImageFeaturizer fused=True vs an explicit emulation of the pre-PR7
+      per-row decode/resize/unroll prep feeding the same TPUModel.
+      Gate: >= 1.5x imgs/sec at CPU smoke scale. The CPU floor is real:
+      decode and the model forward are SHARED costs both paths pay, and on
+      a 2-core smoke box XLA's forward occupies the same cores the per-row
+      loop does, so e2e compression is bounded by prep's share of total
+      time (component breakdown here: per-row prep ~60% of the baseline).
+      The ISSUE's full >= 3x acceptance rides the TPU harness (bench.main),
+      where prep was ~96% of the 279 imgs/sec baseline's cost
+      (BENCH_r05: 279 e2e vs 6,375 device-resident).
+    - prefetch: the double-buffered host->HBM loader (core/prefetch.py) vs
+      the same decode+upload+compute executed serially, on a consumer whose
+      device compute OUTWEIGHS decode (the TPU-shaped regime). Gate: the
+      ISSUE's overlap proof — upload of batch N+1 completes before batch
+      N's compute finishes (shared perf_counter timeline) — for most
+      batches, with throughput no worse than serial minus scheduler noise.
+    - bf16: zoo ResNet-50 geometry (scaled input) scored in bfloat16 vs
+      float32 through TPUModel(dtype=...). Gate: top-1 identical and
+      relative logit MAE < BF16_LOGIT_MAE_TOL; the speedup is recorded,
+      not gated (bf16 only pays on MXU hardware).
+    """
+    import jax
+
+    from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType
+    from mmlspark_tpu.core.prefetch import DeviceBatchPrefetcher
+    from mmlspark_tpu.core.schema import make_image_row
+    from mmlspark_tpu.dnn import resnet_mini
+    from mmlspark_tpu.dnn.network import Network, NetworkBundle
+    from mmlspark_tpu.dnn.zoo_builders import (
+        BF16_LOGIT_MAE_TOL,
+        resnet50_random,
+    )
+    from mmlspark_tpu.images import ImageFeaturizer, device_ops, ops
+    from mmlspark_tpu.io.image import decode_image, encode_image
+    from mmlspark_tpu.models import TPUModel
+    from mmlspark_tpu.models.tpu_model import _compiled_forward
+
+    rng = np.random.default_rng(0)
+    report: dict = {}
+
+    def _npy_bytes(img):
+        import io as _io
+
+        buf = _io.BytesIO()
+        np.save(buf, img)
+        return buf.getvalue()
+
+    # -- 1. fused device prep vs the per-row host loop -----------------------
+    n, src, dst = 192, 96, 48
+    imgs = rng.integers(0, 256, (n, src, src, 3), dtype=np.uint8)
+    stages = [{"op": "resize", "height": dst, "width": dst}]
+    fused = device_ops.fused_prep_program(stages, unroll=True)
+    jax.block_until_ready(fused(device_ops.upload_batch(imgs)))  # warm
+
+    def fused_once():
+        t0 = time.perf_counter()
+        jax.block_until_ready(fused(device_ops.upload_batch(imgs)))
+        return time.perf_counter() - t0
+
+    def per_row_once():
+        # the pre-PR7 dataflow: one Python iteration per image
+        t0 = time.perf_counter()
+        out = np.empty((n, dst * dst * 3), np.float64)
+        for i in range(n):
+            r = ops.resize(imgs[i], dst, dst)
+            out[i] = np.transpose(r, (2, 0, 1)).reshape(-1)
+        return time.perf_counter() - t0
+
+    fused_s = min(fused_once() for _ in range(3))
+    per_row_s = min(per_row_once() for _ in range(3))
+    report["fused_prep"] = {
+        "images": n,
+        "per_row_imgs_per_sec": round(n / per_row_s, 1),
+        "fused_imgs_per_sec": round(n / fused_s, 1),
+        "speedup": round(per_row_s / fused_s, 2),
+    }
+
+    # -- 2. end-to-end featurize, decode included ----------------------------
+    # a deliberately light head so the measurement isolates the PREP path
+    # (the forward is a shared cost both dataflows pay identically)
+    spec = [
+        {"kind": "conv", "filters": 8, "kernel": 3, "stride": 4,
+         "name": "stem"},
+        {"kind": "relu", "name": "act"},
+        {"kind": "global_avg_pool", "name": "pool"},
+        {"kind": "dense", "units": 8, "name": "logits"},
+    ]
+    net = Network(spec, (dst, dst, 3))
+    bundle = NetworkBundle(net, net.init(jax.random.PRNGKey(0)))
+    blobs = np.empty(n, object)
+    blobs[:] = [_npy_bytes(im) for im in imgs]
+    df = DataFrame({"raw": Column(blobs, DataType.BINARY)})
+    feat = ImageFeaturizer(model=bundle, input_col="raw",
+                           output_col="features", cut_output_layers=1)
+    feat.set_mini_batch_size(n)
+    feat.transform(df)  # warm: compiles + weight upload
+
+    inner = TPUModel(bundle, input_col="vec", output_col="features",
+                     mini_batch_size=n)
+    inner.set_output_layer(feat._output_layer())
+
+    def baseline_once():
+        # pre-PR7: per-row decode -> per-row resize -> per-row unroll,
+        # then the same TPUModel the fused path runs
+        t0 = time.perf_counter()
+        vecs = np.empty((n, dst * dst * 3), np.float64)
+        for i in range(n):
+            img = np.asarray(decode_image(bytes(blobs[i]))["data"])
+            r = ops.resize(img, dst, dst)
+            vecs[i] = np.transpose(r, (2, 0, 1)).reshape(-1)
+        frame = DataFrame.from_dict({"vec": vecs})
+        out = inner.transform(frame)
+        np.asarray(out["features"])  # final read (forces the d2h)
+        return time.perf_counter() - t0
+
+    def fused_e2e_once():
+        t0 = time.perf_counter()
+        out = feat.transform(df)
+        np.asarray(out["features"])
+        return time.perf_counter() - t0
+
+    baseline_s = min(baseline_once() for _ in range(3))
+    fused_e2e_s = min(fused_e2e_once() for _ in range(3))
+    report["featurize_e2e"] = {
+        "images": n,
+        "decode_included": True,
+        "per_row_prep_imgs_per_sec": round(n / baseline_s, 1),
+        "fused_imgs_per_sec": round(n / fused_e2e_s, 1),
+        "speedup": round(baseline_s / fused_e2e_s, 2),
+    }
+
+    # -- 3. double-buffered prefetch vs serial decode+upload+compute ---------
+    # PNG blobs (real PIL/zlib host codec work) feeding a consumer whose
+    # device compute outweighs a batch's decode+upload — the TPU-shaped
+    # regime where the prefetcher fully hides the host work. One decode
+    # worker: the smoke box is small (often 2 cores shared with XLA), so
+    # extra decode threads only contend.
+    pf_batches, pf_bs, pf_src = 10, 32, 64
+    pf_imgs = rng.integers(
+        0, 256, (pf_batches * pf_bs, pf_src, pf_src, 3), dtype=np.uint8
+    )
+    pf_blobs = [
+        encode_image(make_image_row(im, ""), fmt="png") for im in pf_imgs
+    ]
+    pf_net = resnet_mini(num_classes=8, input_shape=(dst, dst, 3))
+    pf_bundle = NetworkBundle(pf_net, pf_net.init(jax.random.PRNGKey(1)))
+    fwd = _compiled_forward(pf_net.truncate_at("pool"))
+    dev_vars = pf_bundle.device_variables()
+
+    def decode_chunk(chunk):
+        return np.stack(
+            [np.asarray(decode_image(bytes(b))["data"]) for b in chunk]
+        )
+
+    prep = device_ops.fused_prep_program(stages, unroll=False)
+
+    def compute(dev_batch):
+        y = fwd(dev_vars, np.float32(1 / 255.0) * prep(dev_batch))
+        jax.block_until_ready(y)
+
+    compute(device_ops.upload_batch(pf_imgs[:pf_bs]))  # warm (compiles)
+
+    def serial_run():
+        t0 = time.perf_counter()
+        for i in range(pf_batches):
+            chunk = pf_blobs[i * pf_bs: (i + 1) * pf_bs]
+            compute(device_ops.upload_batch(decode_chunk(chunk)))
+        return time.perf_counter() - t0
+
+    def prefetch_run():
+        windows = []
+        pf = DeviceBatchPrefetcher(
+            pf_blobs, decode_chunk, batch_size=pf_bs, depth=2, workers=1
+        )
+        t0 = time.perf_counter()
+        with pf:
+            for dev_batch in pf:
+                c0 = time.perf_counter()
+                compute(dev_batch)
+                windows.append((c0, time.perf_counter()))
+        total = time.perf_counter() - t0
+        # the ISSUE's overlap proof: batch N+1's upload completed before
+        # batch N's compute finished (timestamps share one perf_counter)
+        tl = pf.timeline()
+        overlapped = sum(
+            1
+            for e in tl
+            if e["index"] > 0
+            and int(e["index"]) - 1 < len(windows)
+            and e["upload_done_t"] <= windows[int(e["index"]) - 1][1]
+        )
+        return total, overlapped, pf.summary()
+
+    serial_s = min(serial_run() for _ in range(2))
+    best = None
+    for _ in range(2):
+        cand = prefetch_run()
+        if best is None or cand[0] < best[0]:
+            best = cand
+    prefetch_s, overlapped, pf_summary = best
+    report["prefetch"] = {
+        "batches": pf_batches,
+        "batch_size": pf_bs,
+        "serial_imgs_per_sec": round(pf_batches * pf_bs / serial_s, 1),
+        "prefetch_imgs_per_sec": round(pf_batches * pf_bs / prefetch_s, 1),
+        "speedup": round(serial_s / prefetch_s, 2),
+        "uploads_overlapping_prev_compute": overlapped,
+        "overlap_ratio": pf_summary["overlap_ratio"],
+    }
+
+    # -- 4. bf16 vs f32 on the zoo flagship geometry -------------------------
+    zoo = resnet50_random(num_classes=10, input_shape=(32, 32, 3))
+    zx = rng.integers(0, 256, (32, 32 * 32 * 3), dtype=np.uint8)
+    zdf = DataFrame.from_dict({"features": zx})
+    f32_model = TPUModel(zoo, input_col="features", output_col="o",
+                         mini_batch_size=32)
+    bf16_model = TPUModel(zoo, input_col="features", output_col="o",
+                          mini_batch_size=32, dtype="bfloat16")
+    f32_logits = np.asarray(f32_model.transform(zdf)["o"])  # warm + truth
+    bf16_logits = np.asarray(bf16_model.transform(zdf)["o"])
+
+    def timed(model):
+        t0 = time.perf_counter()
+        np.asarray(model.transform(zdf)["o"])
+        return time.perf_counter() - t0
+
+    f32_s = min(timed(f32_model) for _ in range(2))
+    bf16_s = min(timed(bf16_model) for _ in range(2))
+    rel_mae = float(
+        np.abs(f32_logits - bf16_logits).mean() / np.abs(f32_logits).mean()
+    )
+    report["bf16"] = {
+        "model": "resnet50_random(10, 32x32x3)",
+        "rel_logit_mae": round(rel_mae, 6),
+        "tolerance": BF16_LOGIT_MAE_TOL,
+        "top1_match": bool(
+            (f32_logits.argmax(axis=1) == bf16_logits.argmax(axis=1)).all()
+        ),
+        "speedup_vs_f32": round(f32_s / bf16_s, 2),
+    }
+
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return report
+
+
 def main() -> int:
     from mmlspark_tpu.dnn import resnet20_cifar
 
@@ -1219,5 +1480,6 @@ if __name__ == "__main__":
         print(json.dumps(run_serving_smoke(), sort_keys=True))
         print(json.dumps(run_obs_overhead_smoke(), sort_keys=True))
         print(json.dumps(run_fault_smoke(), sort_keys=True))
+        print(json.dumps(run_image_prep_smoke(), sort_keys=True))
         sys.exit(0)
     sys.exit(main())

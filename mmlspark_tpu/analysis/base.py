@@ -26,6 +26,8 @@ RULES: Dict[str, str] = {
     "broad-except": "bare except/except Exception that neither re-raises nor records the error",
     # hot-path family (hot_path.py)
     "host-sync-in-hot-path": "np.asarray/float()/block_until_ready on device-backed column values inside transform",
+    # batch-loop family (batch_loop.py)
+    "host-roundtrip-in-batch-loop": "per-row numpy/image-op compute over column rows inside a loop; batch it or use the fused device path",
     # lock-scope family (lock_scope.py)
     "blocking-host-work-under-lock": "json.loads/json.dumps/parse_request/make_reply inside a model-lock critical section starves device dispatch",
     # monotonic-time family (monotonic_time.py)

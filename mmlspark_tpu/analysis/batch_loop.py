@@ -1,0 +1,216 @@
+"""Batch-loop rule: per-row numpy compute over a column's rows.
+
+`host-roundtrip-in-batch-loop` flags, inside the image/featurize/stage
+modules (the tiers whose columns may be device-backed), numpy/image-op
+COMPUTE applied to individual rows of a DataFrame column inside a Python
+loop:
+
+- taint sources are column pulls — ``df[...]`` subscripts and ``.values``
+  on a ``.column(...)`` result. On a device-backed column that access is
+  itself a counted d2h sync; looping rows afterwards then re-does on the
+  host, one row at a time, work the fused device path
+  (images/device_ops.py) or the batched host ops (ops.resize_batch /
+  ops.resize_groups / ops.unroll) run once per batch — the exact shape of
+  the 23x featurize gap BENCH_r05 measured;
+- a ``for`` target (or comprehension target) iterating a tainted value is
+  a ROW; ``enumerate(tainted)`` marks the second tuple element;
+- a call ``ops.<fn>(...)`` or ``np.<fn>(...)`` with a row in its arguments
+  is a finding — except numpy CONSTRUCTORS/CONVERTERS (`asarray`, `array`,
+  `stack`, ...): collecting object rows into one ndarray is the *fix*
+  (stack once, then one batched call), not the bug.
+
+Nested matches report once (the outermost call). A loop that genuinely
+cannot batch — per-row parameters, mixed op chains — takes a justified
+``# graftcheck: ignore[host-roundtrip-in-batch-loop]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from mmlspark_tpu.analysis.base import Finding
+
+_RULE = "host-roundtrip-in-batch-loop"
+
+#: numpy attrs that CONVERT/COLLECT rather than compute — per-row use is
+#: how a loop body stages rows for one batched call, so they stay clean
+_NP_CONVERTERS = {
+    "asarray", "array", "stack", "concatenate", "frombuffer", "ravel",
+    "empty", "zeros", "ones", "full", "copy",
+}
+_NUMPY_MODULES = {"np", "numpy"}
+_OPS_MODULES = {"ops"}
+
+
+def _is_column_pull(node: ast.AST) -> bool:
+    """True for `df[...]` and `<expr>.column(...).values`-shaped reads."""
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == "df":
+            return True
+    if isinstance(node, ast.Attribute) and node.attr == "values":
+        for sub in ast.walk(node.value):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "column"
+            ):
+                return True
+    return False
+
+
+def _is_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    for sub in ast.walk(node):
+        if _is_column_pull(sub):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+    return False
+
+
+def _row_targets(target: ast.AST, it: ast.AST, tainted: Set[str]) -> Set[str]:
+    """Loop-target names bound to individual column rows, given iter `it`."""
+    rows: Set[str] = set()
+    enumerated = (
+        isinstance(it, ast.Call)
+        and isinstance(it.func, ast.Name)
+        and it.func.id == "enumerate"
+    )
+    if enumerated:
+        if not (it.args and _is_tainted(it.args[0], tainted)):
+            return rows
+        # for i, row in enumerate(values): the second element is the row
+        if isinstance(target, ast.Tuple) and len(target.elts) == 2:
+            second = target.elts[1]
+            if isinstance(second, ast.Name):
+                rows.add(second.id)
+        return rows
+    if not _is_tainted(it, tainted):
+        return rows
+    if isinstance(target, ast.Name):
+        rows.add(target.id)
+    elif isinstance(target, ast.Tuple):
+        rows.update(e.id for e in target.elts if isinstance(e, ast.Name))
+    return rows
+
+
+def _touches_row(node: ast.AST, rows: Set[str]) -> bool:
+    return any(
+        isinstance(sub, ast.Name) and sub.id in rows for sub in ast.walk(node)
+    )
+
+
+def _flaggable(call: ast.Call, rows: Set[str]) -> Optional[str]:
+    """The offending `module.fn` string when `call` is per-row compute."""
+    func = call.func
+    if not (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+    ):
+        return None
+    mod = func.value.id
+    if mod in _OPS_MODULES:
+        pass  # every single-image op has a batch/device equivalent
+    elif mod in _NUMPY_MODULES:
+        if func.attr in _NP_CONVERTERS:
+            return None
+    else:
+        return None
+    args = list(call.args) + [kw.value for kw in call.keywords]
+    if any(_touches_row(a, rows) for a in args):
+        return f"{mod}.{func.attr}"
+    return None
+
+
+def _scan_body(
+    body: List[ast.stmt], rows: Set[str], rel: str, findings: List[Finding]
+) -> None:
+    """Flag per-row compute calls in a loop body; outermost match only."""
+    flagged_spans: List[ast.Call] = []
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            if any(node is not f and _contains(f, node) for f in flagged_spans):
+                continue
+            name = _flaggable(node, rows)
+            if name is not None:
+                flagged_spans.append(node)
+                findings.append(Finding(
+                    _RULE, rel, node.lineno,
+                    f"{name}() on a single column row inside a batch loop — "
+                    "stack the rows once and call the batched op "
+                    "(resize_batch/resize_groups/unroll) or the fused "
+                    "device path (images/device_ops)",
+                ))
+
+
+def _contains(outer: ast.AST, inner: ast.AST) -> bool:
+    return any(sub is inner for sub in ast.walk(outer))
+
+
+def _scan_function(fn: ast.AST, rel: str, findings: List[Finding]) -> None:
+    tainted: Set[str] = set()
+    # pass 1: taint propagation through simple assignments. ast.walk is
+    # breadth-first, not source order, so iterate to a fixpoint — an alias
+    # read at an outer level from a pull bound inside a nested block
+    # (`if cond: vals = df[...]` then `rows = vals`) still taints
+    grew = True
+    while grew:
+        grew = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _is_tainted(node.value, tainted):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id not in tainted:
+                        tainted.add(tgt.id)
+                        grew = True
+    # pass 2: loops and comprehensions over tainted values
+    for node in ast.walk(fn):
+        if isinstance(node, ast.For):
+            rows = _row_targets(node.target, node.iter, tainted)
+            if rows:
+                _scan_body(node.body, rows, rel, findings)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            rows = set()
+            for gen in node.generators:
+                rows |= _row_targets(gen.target, gen.iter, tainted)
+            if rows:
+                _scan_body([ast.Expr(value=node.elt)], rows, rel, findings)
+        elif isinstance(node, ast.DictComp):
+            rows = set()
+            for gen in node.generators:
+                rows |= _row_targets(gen.target, gen.iter, tainted)
+            if rows:
+                _scan_body(
+                    [ast.Expr(value=node.key), ast.Expr(value=node.value)],
+                    rows, rel, findings,
+                )
+
+
+def check_batch_loop(
+    paths: List[str], repo_root: Optional[str] = None
+) -> List[Finding]:
+    repo_root = repo_root or os.getcwd()
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, repo_root)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_function(node, rel, findings)
+    # a nested function is walked from its enclosing scope too — dedupe
+    seen: Set = set()
+    unique: List[Finding] = []
+    for f in findings:
+        key = (f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique

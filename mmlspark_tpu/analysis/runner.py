@@ -86,6 +86,23 @@ def run_all(
         from mmlspark_tpu.analysis.hot_path import check_hot_path
 
         findings += check_hot_path(package_files, repo_root=root)
+    if "host-roundtrip-in-batch-loop" in enabled:
+        from mmlspark_tpu.analysis.batch_loop import check_batch_loop
+
+        # scoped to the tiers whose columns may be device-backed (the ISSUE
+        # 7 image dataplane): images/, featurize/, and the stage library
+        batch_dirs = (
+            os.path.join(package_name, "images") + os.sep,
+            os.path.join(package_name, "featurize") + os.sep,
+            os.path.join(package_name, "stages") + os.sep,
+        )
+        findings += check_batch_loop(
+            [
+                p for p in package_files
+                if any(d in os.path.relpath(p, root) for d in batch_dirs)
+            ],
+            repo_root=root,
+        )
     if "blocking-host-work-under-lock" in enabled:
         from mmlspark_tpu.analysis.lock_scope import check_lock_scope
 

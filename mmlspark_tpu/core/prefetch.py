@@ -1,0 +1,309 @@
+"""Double-buffered host->HBM batch prefetch for the device dataplane.
+
+Host decode (JPEG/PNG bytes -> numpy, inherently host work) runs in a
+worker pool feeding staged host batches; a single pipeline thread uploads
+each staged batch to device HBM — uploads stay SERIALIZED (BASELINE.md
+round 3: concurrent in-flight device_puts collapse tunnel throughput ~50x)
+— and parks up to `depth` device-resident batches in a bounded queue. The
+consumer drains the queue while the next batch decodes and uploads behind
+it, so batch N+1's h2d overlaps batch N's device compute.
+
+Overlap is MEASURED, not assumed: every batch records decode/upload/
+request timestamps, `summary()` reports the overlap ratio (1 - consumer
+wait / producer prep, clamped to [0, 1]) and the count of batches whose
+upload finished before the consumer asked — the gateable evidence for
+"prefetch fully overlaps compute" (ROADMAP streaming-ingestion item; the
+bench gate in tests/test_bench_smoke.py). Uploads land in the same
+profiling.dataplane_counters() every other transfer point reports to, and
+the loader exports `dataplane_prefetch_*` registry metrics including the
+`dataplane_prefetch_overlap_ratio` gauge.
+
+Lifecycle: the pipeline thread holds NO strong reference to the public
+DeviceBatchPrefetcher — only to its internal state — and a
+``weakref.finalize`` stops the pipeline when the public object is
+collected. So a consumer that breaks out of a bare ``for`` loop and drops
+the iterator cannot strand a producer spinning on a full queue pinning
+device batches; explicit ``close()`` (or the context manager) remains the
+deterministic way to release resources immediately.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import weakref
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+_SENTINEL = object()
+
+
+_METRICS: Dict[str, Any] = {}
+
+
+def _metrics() -> Dict[str, Any]:
+    """Process-wide prefetch instruments, created on first use (keeps this
+    module import-light and obs-optional)."""
+    if not _METRICS:
+        from mmlspark_tpu.obs.metrics import registry
+
+        reg = registry()
+        _METRICS["batches"] = reg.counter(
+            "dataplane_prefetch_batches_total",
+            "Batches staged through the host->HBM prefetcher")
+        _METRICS["overlapped"] = reg.counter(
+            "dataplane_prefetch_overlapped_batches_total",
+            "Prefetched batches whose upload finished before the consumer "
+            "asked for them")
+        _METRICS["ratio"] = reg.gauge(
+            "dataplane_prefetch_overlap_ratio",
+            "1 - consumer wait / producer prep for the most recently "
+            "finished prefetch loop (1.0 = prep fully hidden)")
+    return _METRICS
+
+
+class _PrefetchState:
+    """Everything the pipeline thread touches — shared with (but not
+    owning) the public DeviceBatchPrefetcher, so the thread cannot keep an
+    abandoned prefetcher alive."""
+
+    def __init__(self, depth: int):
+        self.q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self.stop = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.timeline: List[Dict[str, float]] = []
+        self.tl_lock = threading.Lock()
+
+
+def _produce(
+    state: _PrefetchState,
+    chunks: List[List[Any]],
+    decode_fn: Callable[[List[Any]], np.ndarray],
+    workers: int,
+    upload: bool,
+    sharding: Any,
+) -> None:
+    def stage(chunk):
+        t0 = time.perf_counter()
+        host = decode_fn(chunk)
+        return host, time.perf_counter() - t0
+
+    try:
+        with ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="prefetch-decode"
+        ) as pool:
+            # sliding submit window: keeps the pool busy without letting
+            # decoded host batches pile up unboundedly ahead of uploads
+            window = workers + 1
+            futures = [pool.submit(stage, c) for c in chunks[:window]]
+            next_submit = len(futures)
+            for idx in range(len(chunks)):
+                if state.stop.is_set():
+                    break
+                host, decode_s = futures[idx].result()
+                if next_submit < len(chunks):
+                    futures.append(pool.submit(stage, chunks[next_submit]))
+                    next_submit += 1
+                t_up = time.perf_counter()
+                if upload:
+                    import jax
+
+                    from mmlspark_tpu.images.device_ops import upload_batch
+
+                    batch = upload_batch(host, sharding)
+                    # block: "upload done" must mean bytes ON the device,
+                    # and serialized uploads are the measured fast path
+                    # for the tunnel-attached chip
+                    jax.block_until_ready(batch)
+                else:
+                    batch = host
+                upload_done = time.perf_counter()
+                entry = {
+                    "index": float(idx),
+                    "decode_s": decode_s,
+                    "upload_s": upload_done - t_up,
+                    "upload_done_t": upload_done,
+                    "requested_t": -1.0,
+                    "wait_s": -1.0,
+                }
+                with state.tl_lock:
+                    state.timeline.append(entry)
+                while not state.stop.is_set():
+                    try:
+                        state.q.put((idx, batch, entry), timeout=0.05)
+                        break
+                    except queue.Full:
+                        continue
+    except BaseException as e:  # surfaced to the consumer in __next__
+        state.error = e
+    finally:
+        # the sentinel must ALWAYS land — including when stop was set while
+        # the consumer is already blocked in q.get() on an empty queue
+        # (close() from another thread, or the weakref finalizer). While
+        # the consumer is live we wait for space so no staged batch is
+        # lost; once stop is set nobody wants those batches, and the
+        # producer is the only putter, so draining one slot guarantees the
+        # put_nowait succeeds.
+        while True:
+            if state.stop.is_set():
+                try:
+                    state.q.put_nowait(_SENTINEL)
+                    break
+                except queue.Full:
+                    try:
+                        state.q.get_nowait()
+                    except queue.Empty:
+                        pass
+            else:
+                try:
+                    state.q.put(_SENTINEL, timeout=0.05)
+                    break
+                except queue.Full:
+                    continue
+
+
+class DeviceBatchPrefetcher:
+    """Iterate device-resident batches decoded and uploaded ahead of the
+    consumer.
+
+    Parameters
+    ----------
+    items: the full work list (bytes blobs, paths, rows — anything).
+    decode_fn: list-of-items -> host numpy batch; runs in the worker pool.
+        This is where per-item host work (image decode, parsing) belongs.
+    batch_size: items per staged batch.
+    depth: device batches parked ahead of the consumer (the double buffer;
+        2 keeps one uploading while one is consumed).
+    workers: decode pool size (decode parallelism; uploads stay serial).
+    upload: False yields host batches instead (decode-only prefetch).
+
+    Use as an iterator (or context manager for early-exit cleanup):
+
+        with DeviceBatchPrefetcher(blobs, decode, batch_size=64) as pf:
+            for dev_batch in pf:
+                y = model_fn(dev_batch)      # overlaps the next upload
+        pf.summary()["overlap_ratio"]
+
+    A bare iterator works too; on early exit, call close() to release the
+    pipeline immediately (dropping the object also stops it, via GC).
+    """
+
+    def __init__(
+        self,
+        items: Sequence[Any],
+        decode_fn: Callable[[List[Any]], np.ndarray],
+        batch_size: int = 64,
+        depth: int = 2,
+        workers: int = 2,
+        upload: bool = True,
+        sharding: Any = None,
+    ):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        items = list(items)
+        bs = int(batch_size)
+        chunks = [items[i: i + bs] for i in range(0, len(items), bs)]
+        self._state = _PrefetchState(max(1, int(depth)))
+        self._started = False
+        # the thread closes over state/chunks/decode_fn only — NOT self —
+        # so an abandoned prefetcher is collectable, and this finalizer
+        # then stops the producer (it also runs at interpreter shutdown)
+        self._finalizer = weakref.finalize(self, self._state.stop.set)
+        self._thread = threading.Thread(
+            target=_produce,
+            args=(self._state, chunks, decode_fn,
+                  max(1, int(workers)), upload, sharding),
+            name="prefetch-pipeline", daemon=True,
+        )
+
+    # -- consumer side -----------------------------------------------------
+
+    def __iter__(self) -> "DeviceBatchPrefetcher":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def __next__(self) -> Any:
+        if not self._started:
+            self.__iter__()
+        state = self._state
+        t_req = time.perf_counter()
+        while True:
+            try:
+                item = state.q.get(timeout=0.05)
+                break
+            except queue.Empty:
+                # close()/finalize can race a consumer already parked in
+                # get(): once stop is set and the queue is drained, nothing
+                # more is coming — finish rather than block forever
+                if state.stop.is_set():
+                    item = _SENTINEL
+                    break
+        if item is _SENTINEL:
+            self._finish()
+            if state.error is not None:
+                raise state.error
+            raise StopIteration
+        idx, batch, entry = item
+        now = time.perf_counter()
+        entry["requested_t"] = t_req
+        entry["wait_s"] = now - t_req
+        m = _metrics()
+        m["batches"].inc()
+        if idx > 0 and entry["upload_done_t"] <= t_req:
+            m["overlapped"].inc()
+        return batch
+
+    def __enter__(self) -> "DeviceBatchPrefetcher":
+        return self.__iter__()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        """Stop the pipeline (idempotent; safe after partial consumption)."""
+        self._state.stop.set()
+        if self._started:
+            self._thread.join(timeout=5.0)
+
+    def _finish(self) -> None:
+        _metrics()["ratio"].set(self.summary()["overlap_ratio"])
+
+    # -- evidence ----------------------------------------------------------
+
+    def timeline(self) -> List[Dict[str, float]]:
+        """Per-batch timestamps (perf_counter clock): decode_s, upload_s,
+        upload_done_t, requested_t, wait_s. The overlap proof compares
+        upload_done_t of batch N+1 against the consumer's compute window
+        for batch N."""
+        state = self._state
+        with state.tl_lock:
+            return [dict(e) for e in state.timeline]
+
+    def summary(self) -> Dict[str, float]:
+        """Overlap evidence: batches, overlapped_batches (upload finished
+        before the consumer asked), wait vs prep seconds, and
+        overlap_ratio = 1 - wait/prep clamped to [0, 1]."""
+        state = self._state
+        with state.tl_lock:
+            consumed = [e for e in state.timeline if e["wait_s"] >= 0]
+            # the first batch can never overlap anything: nothing was
+            # computing while it staged, so it is excluded from the ratio
+            tail = [e for e in consumed if e["index"] > 0]
+            wait = sum(e["wait_s"] for e in tail)
+            prep = sum(e["decode_s"] + e["upload_s"] for e in tail)
+            overlapped = sum(
+                1 for e in tail if e["upload_done_t"] <= e["requested_t"]
+            )
+            ratio = 1.0 - (wait / prep) if prep > 0 else 0.0
+            return {
+                "batches": len(consumed),
+                "overlapped_batches": overlapped,
+                "overlap_ratio": round(max(0.0, min(1.0, ratio)), 4),
+                "wait_s": round(wait, 4),
+                "prep_s": round(prep, 4),
+            }

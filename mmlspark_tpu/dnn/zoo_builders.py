@@ -6,26 +6,73 @@ bytes — and verify the MANIFEST-pinned sha256 — on any backend. This stands
 in for the reference's CDN-hosted CNTK checkpoints
 (ModelDownloader.scala:209-267): zero-egress builds can't download, so the
 zoo pins recipes instead of blobs.
+
+Inference dtype variants: every zoo model can be scored in bfloat16 — half
+the MXU cycle cost per MAC on TPU — either per stage (`TPUModel(dtype=
+"bfloat16")`, which shares the bundle's one weight upload and just compiles
+a second program) or as a bundle-level twin (`bf16_variant`, for callers
+that hold bundles, e.g. serving model registries). Weights stay float32 in
+HBM either way; layers cast per-op (Network._cast_in / .astype(x.dtype)).
+Parity is gated, not assumed: bf16 logits must match f32 within
+`BF16_LOGIT_MAE_TOL` relative mean-absolute-error and agree on top-1 for
+the smoke batch (tests/test_image_dataplane.py, bench.run_image_prep_smoke).
+`dtype="float32"` remains the rollback default everywhere.
 """
 
 from __future__ import annotations
 
 from typing import Sequence
 
-from mmlspark_tpu.dnn.network import NetworkBundle, deterministic_variables
+from mmlspark_tpu.dnn.network import (
+    Network,
+    NetworkBundle,
+    deterministic_variables,
+)
 from mmlspark_tpu.dnn.resnet import resnet50
+
+#: Documented bf16-vs-f32 parity tolerance: RELATIVE mean absolute logit
+#: error — mean|f32 - bf16| / mean|f32| — on a smoke batch must stay under
+#: this bound, and top-1 must match exactly. bf16 carries 8 mantissa bits
+#: (~4e-3 relative rounding per op); 5e-2 bounds the drift compounded
+#: across a ResNet-50's depth while still catching real numeric bugs (a
+#: wrong accumulation dtype shows up orders of magnitude above this).
+#: Relative, not absolute: logit SCALE is model-dependent (a random-init
+#: zoo ResNet-50's un-adapted BN leaves logits at O(1e4)).
+BF16_LOGIT_MAE_TOL = 5e-2
 
 
 def resnet50_random(
     num_classes: int = 1000,
     input_shape: Sequence[int] = (224, 224, 3),
     seed: int = 0,
+    dtype: str = "float32",
 ) -> NetworkBundle:
     """Randomly-initialized ResNet-50 (ImageNet geometry, ~25.5M params).
 
     Random weights are fine for the featurization/serving benches and the
     transfer-learning plumbing (random conv features are still a usable
     embedding); a trained checkpoint would drop in through the same entry.
+
+    `dtype="bfloat16"` returns the bf16 inference variant: identical
+    variables (deterministic_variables depends only on leaf shapes, so the
+    MANIFEST sha256 is dtype-independent), bf16 compute.
     """
     net = resnet50(num_classes=num_classes, input_shape=tuple(input_shape))
+    if dtype != net.compute_dtype:
+        net = Network(net.spec, net.input_shape, dtype)
     return NetworkBundle(net, deterministic_variables(net, seed))
+
+
+def bf16_variant(bundle: NetworkBundle) -> NetworkBundle:
+    """The bfloat16 inference twin of an existing bundle: shares the SAME
+    variables dict (weights stay float32; layers cast activations per-op),
+    swaps only the network's compute dtype. Note the twin is a distinct
+    bundle, so it pays its own one-time weight upload — stages that should
+    share the upload use `TPUModel(dtype="bfloat16")` on the original
+    bundle instead."""
+    net = bundle.network
+    if net.compute_dtype == "bfloat16":
+        return bundle
+    return NetworkBundle(
+        Network(net.spec, net.input_shape, "bfloat16"), bundle.variables
+    )

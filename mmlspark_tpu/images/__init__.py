@@ -5,10 +5,14 @@ Equivalent of the reference's image-transformer AND image-featurizer modules
 ImageFeaturizer.scala:129-177, ImageLIME.scala:75-163,
 Superpixel.scala:154-273, SuperpixelTransformer.scala:33.
 
-Design note: pre-resize images are ragged (per-row sizes differ), so the
-transform ops run per-row on host in numpy — exactly where the reference
-runs OpenCV. The TPU path begins at UnrollImage: fixed-size CHW vectors,
-batched into HBM by TPUModel/ImageFeaturizer.
+Design note: image DECODE is inherently host work (ragged object rows), but
+everything after it is batchable. Uniform batches run the fused device prep
+path (images/device_ops.py): the whole resize/crop/flip/color/normalize/
+unroll chain compiles into ONE XLA program over the (N, H, W, C) batch, fed
+by a single uint8 upload — images/ops.py stays the numpy semantic oracle it
+is parity-gated against. Ragged host fallbacks batch by shape
+(ops.resize_groups) instead of looping per row. See docs/dataplane.md
+"Image dataplane".
 """
 
 from mmlspark_tpu.images.transformer import (

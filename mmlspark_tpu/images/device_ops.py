@@ -1,0 +1,362 @@
+"""Device-resident image preprocessing: the ImageTransformer op set as
+jitted batched ops on (N, H, W, C) tensors.
+
+The numpy ops in images/ops.py remain the SEMANTIC ORACLE — every op here
+mirrors one of them and is parity-gated against it (tests/
+test_image_dataplane.py: ±1 uint8 LSB for resize/crop/flip/color, 1e-5 for
+normalize/unroll). The difference is execution shape: instead of a Python
+loop resizing one row at a time on the host (BENCH_r05: 279 imgs/sec
+through that path vs 6,375 device-resident — a 23x gap), a whole stage
+CHAIN compiles into ONE XLA program over the full batch. The chip sees a
+single fused gather+FMA+transpose kernel; the host sees one upload.
+
+Programs are cached process-wide in core.dispatch.DispatchCache keyed by
+the canonical chain signature, and every first (chain, input-shape)
+dispatch is counted as a compile in profiling.dataplane_counters() — the
+same accounting every other device stage uses.
+
+Uint8 semantics: the oracle quantizes (np.rint -> uint8) after every op, so
+the fused chain quantizes between stages too (jnp.rint on the f32
+intermediate) — per-op parity holds through a chain, not just for single
+ops. Values stay in [0, 255] (bilinear/gray are convex combinations), so
+no clipping is needed. normalize/unroll are float-valued terminal ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.dispatch import dispatch_cache
+from mmlspark_tpu.utils.profiling import dataplane_counters
+
+#: ops the fused device path supports (blur/threshold/gaussian stay
+#: host-only for now: rarely on the featurize hot path)
+DEVICE_OPS = ("resize", "crop", "colorformat", "flip", "normalize")
+
+#: OpenCV BGR2GRAY weights over (B, G, R) planes — same constants as the
+#: numpy oracle (images/ops.py color_format)
+_GRAY_W = (0.114, 0.587, 0.299)
+
+
+def _resize_plan(h: int, w: int, height: int, width: int):
+    """Static gather indices + lerp weights for OpenCV INTER_LINEAR
+    pixel-center mapping — identical math to ops.resize_batch, computed
+    once on the host and baked into the program as constants."""
+    out_y = (np.arange(height) + 0.5) * h / height - 0.5
+    out_x = (np.arange(width) + 0.5) * w / width - 0.5
+    y0 = np.clip(np.floor(out_y).astype(np.int32), 0, h - 1)
+    x0 = np.clip(np.floor(out_x).astype(np.int32), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    fy = np.clip(out_y - y0, 0, 1).astype(np.float32)[None, :, None, None]
+    fx = np.clip(out_x - x0, 0, 1).astype(np.float32)[None, None, :, None]
+    return y0, y1, x0, x1, fy, fx
+
+
+def _resize(x, st):
+    import jax.numpy as jnp
+
+    height, width = st["height"], st["width"]
+    h, w = int(x.shape[1]), int(x.shape[2])
+    if (h, w) == (height, width):
+        return x
+    y0, y1, x0, x1, fy, fx = _resize_plan(h, w, height, width)
+    top_rows = jnp.take(x, y0, axis=1)
+    bot_rows = jnp.take(x, y1, axis=1)
+    t_l = jnp.take(top_rows, x0, axis=2)
+    t_r = jnp.take(top_rows, x1, axis=2)
+    b_l = jnp.take(bot_rows, x0, axis=2)
+    b_r = jnp.take(bot_rows, x1, axis=2)
+    top = t_l * (1 - fx) + t_r * fx
+    bot = b_l * (1 - fx) + b_r * fx
+    return jnp.rint(top * (1 - fy) + bot * fy)
+
+
+def _crop(x, st):
+    cx, cy = st["x"], st["y"]
+    ch, cw = st["height"], st["width"]
+    h, w = int(x.shape[1]), int(x.shape[2])
+    if cy + ch > h or cx + cw > w or cx < 0 or cy < 0:
+        raise ValueError(f"crop ({cx},{cy},{cw}x{ch}) outside image {w}x{h}")
+    return x[:, cy : cy + ch, cx : cx + cw, :]
+
+
+def _flip(x, st):
+    code = st["flip_code"]
+    if code == 0:
+        return x[:, ::-1, :, :]
+    if code > 0:
+        return x[:, :, ::-1, :]
+    return x[:, ::-1, ::-1, :]
+
+
+def _colorformat(x, st):
+    import jax.numpy as jnp
+
+    fmt = st["format"].lower()
+    if fmt in ("bgr", "identity"):
+        return x
+    if int(x.shape[3]) == 1:
+        if fmt == "gray":
+            return x
+        raise ValueError("cannot convert grayscale to color")
+    if fmt == "gray":
+        w = jnp.asarray(_GRAY_W, x.dtype)
+        return jnp.rint((x[..., :3] * w).sum(axis=-1, keepdims=True))
+    if fmt == "rgb":
+        return x[..., ::-1]
+    raise ValueError(f"unknown color format {fmt!r}")
+
+
+def _normalize(x, st):
+    import jax.numpy as jnp
+
+    mean = jnp.asarray(np.asarray(st["mean"], np.float32))
+    std = jnp.asarray(np.asarray(st["std"], np.float32))
+    scale = np.float32(st.get("color_scale_factor", 1.0))
+    return (x * scale - mean) / std
+
+
+_APPLY: Dict[str, Callable] = {
+    "resize": _resize,
+    "crop": _crop,
+    "flip": _flip,
+    "colorformat": _colorformat,
+    "normalize": _normalize,
+}
+
+
+def _unroll(x):
+    """NHWC -> flat CHW float vectors — the UnrollImage layout (BGR channel
+    planes), so fused prep output carries the same "unrolled" metadata
+    contract host unroll does."""
+    import jax.numpy as jnp
+
+    n = x.shape[0]
+    return jnp.transpose(x, (0, 3, 1, 2)).reshape(n, -1)
+
+
+def chain_out_shape(
+    stages: Sequence[Dict[str, Any]], in_shape: Tuple[int, int, int]
+) -> Tuple[int, int, int]:
+    """(H, W, C) after running `stages` — drives the "unrolled" metadata and
+    the consuming network's input-shape check without tracing anything."""
+    h, w, c = in_shape
+    for st in stages:
+        op = st["op"]
+        if op == "resize":
+            h, w = st["height"], st["width"]
+        elif op == "crop":
+            h, w = st["height"], st["width"]
+        elif op == "colorformat" and st["format"].lower() == "gray":
+            c = 1
+        # flip / rgb / normalize: shape-preserving
+    return h, w, c
+
+
+def supported_chain(stages: Sequence[Dict[str, Any]]) -> bool:
+    """True when every stage has a device implementation."""
+    return all(st.get("op") in DEVICE_OPS for st in stages)
+
+
+def _chain_key(
+    stages: Sequence[Dict[str, Any]],
+    unroll: bool,
+    in_shape: Optional[Tuple[int, int, int]] = None,
+):
+    sig = tuple(
+        tuple(sorted((k, _hashable(v)) for k, v in st.items())) for st in stages
+    )
+    return ("images.fused_prep", sig, unroll, in_shape)
+
+
+def _hashable(v: Any):
+    if isinstance(v, (list, tuple, np.ndarray)):
+        return tuple(float(x) for x in np.asarray(v).ravel())
+    return v
+
+
+def fused_prep_program(
+    stages: Sequence[Dict[str, Any]],
+    unroll: bool = True,
+    in_shape: Optional[Tuple[int, int, int]] = None,
+) -> Callable:
+    """Compile `stages` (ImageTransformer stage dicts) into ONE jitted
+    program over an (N, H, W, C) batch; returns a callable batch -> device
+    array ((N, C*H*W) f32 when `unroll`, else (N, H', W', C') f32).
+
+    `in_shape=(H, W, C)` accepts flat (N, H*W*C) input instead and folds
+    the un-flatten into the same program — the serving shape, where pixel
+    columns travel as flat uint8 VECTORs (core/dataframe has no 4-D column
+    type) and the reshape must not be a separate dispatch.
+
+    Oracle parity holds per stage: value-producing uint8 ops round to
+    integers (jnp.rint) exactly like the numpy oracle does before the next
+    stage reads them (integers <= 255 are exact in f32), so a chain's ±1
+    LSB bound does not compound. Programs are shared process-wide through
+    the dispatch cache; per-shape compiles are counted in
+    dataplane_counters().
+    """
+    stages = [dict(st) for st in stages]
+    for st in stages:
+        if st.get("op") not in DEVICE_OPS:
+            raise ValueError(
+                f"op {st.get('op')!r} has no device implementation "
+                f"(supported: {DEVICE_OPS})"
+            )
+    in_shape = tuple(int(d) for d in in_shape) if in_shape is not None else None
+    key = _chain_key(stages, unroll, in_shape)
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        def prep(x):
+            y = x.astype(jnp.float32)
+            if in_shape is not None:
+                y = y.reshape((-1,) + in_shape)
+            for st in stages:
+                y = _APPLY[st["op"]](y, st)
+            return _unroll(y) if unroll else y
+
+        return jax.jit(prep)
+
+    fn = dispatch_cache().compiled(key, build)
+
+    def run(batch):
+        if in_shape is None and batch.ndim == 3:  # grayscale HWC=1 convention
+            batch = batch[:, :, :, None] if isinstance(batch, np.ndarray) else batch[..., None]
+        dispatch_cache().note_dispatch(key, tuple(int(d) for d in batch.shape))
+        return fn(batch)
+
+    return run
+
+
+def image_row_arrays(values: Sequence[Any]) -> Optional[list]:
+    """Validate image-struct rows into HWC ndarrays (grayscale widened to
+    HxWx1), or None when any row can't batch (null, non-dict, data=None).
+    The ONE place the row contract lives — every fused_unrolled_batch call
+    site goes through it."""
+    if not len(values):
+        return None
+    arrays = []
+    for row in values:
+        if row is None or not isinstance(row, dict) or row.get("data") is None:
+            return None
+        img = np.asarray(row["data"])
+        if img.ndim == 2:
+            img = img[:, :, None]
+        arrays.append(img)
+    return arrays
+
+
+def upload_batch(host_batch: np.ndarray, sharding: Any = None):
+    """Counted host->HBM upload of a staged uint8/float batch — the one
+    pipeline-entry transfer of a fused image chain."""
+    import jax
+
+    dataplane_counters().record_h2d(host_batch.nbytes)
+    return (
+        jax.device_put(host_batch)
+        if sharding is None
+        else jax.device_put(host_batch, sharding)
+    )
+
+
+def prep_image_batch(
+    batch: Any,
+    stages: Sequence[Dict[str, Any]],
+    unroll: bool = True,
+    sharding: Any = None,
+):
+    """Run the fused chain over `batch`: a host (N, H, W, C) uint8 array
+    (uploaded once, counted) or an already device-resident batch (no
+    transfer). Returns the device result."""
+    if isinstance(batch, np.ndarray):
+        batch = upload_batch(batch, sharding)
+    return fused_prep_program(stages, unroll=unroll)(batch)
+
+
+def fused_unrolled_batch(
+    arrays: Sequence[np.ndarray],
+    size: Optional[Tuple[int, int]] = None,
+    sharding: Any = None,
+    max_rows: Optional[int] = None,
+    pad_to_bucket: bool = False,
+):
+    """The ONE uniform/ragged dispatch behind every fused-unroll call site
+    (ImageFeaturizer, UnrollImage(to_device=True), the image serving
+    handler): pick the minimal stage chain for a list of HWC arrays, run
+    the fused program, and return (device_vector, metadata).
+
+    arrays: HWC ndarrays (grayscale already widened to HxWx1, no Nones —
+        the image_row_arrays contract).
+    size: (height, width) target; None keeps the native size (uniform
+        batches only).
+    max_rows: upload/program row bound. A larger batch stages and
+        dispatches in max_rows chunks (last chunk padded so every chunk
+        shares ONE compiled program) and the device outputs concatenate —
+        a 500k-row column must not become a single giant h2d + XLA
+        program sized to the whole frame (ImageFeaturizer passes its
+        mini_batch_size).
+    pad_to_bucket: pad the row count to the next power of two and trim the
+        result (compiled, transfer-free) — the serving shape, where the
+        adaptive coalescer produces many distinct batch sizes and tracing
+        a program per exact N would stall the parse stage (same bucketing
+        discipline as TPUModel dispatch).
+    Returns None when the batch cannot fuse: empty, mixed channel counts,
+    or ragged shapes with no target size.
+
+    Chain selection: a uniform batch already at target size unrolls with
+    stages=[] (nothing to resize); a uniform off-size batch fuses the
+    resize into the device program; ragged source shapes host-resize
+    grouped by shape (one ops.resize_batch per distinct shape) and the
+    device chain is unroll-only.
+    """
+    from mmlspark_tpu.core.dispatch import bucket_rows, pad_rows, trim_rows
+    from mmlspark_tpu.images import ops
+
+    if not len(arrays):
+        return None
+    if len({a.shape[2] for a in arrays}) != 1:
+        return None
+    uniform = len({a.shape for a in arrays}) == 1
+    if uniform:
+        batch = np.stack(arrays)
+        if size is None or tuple(batch.shape[1:3]) == tuple(size):
+            stages: list = []
+        else:
+            stages = [{"op": "resize", "height": size[0], "width": size[1]}]
+    elif size is None:
+        return None
+    else:
+        batch = np.stack(ops.resize_groups(list(arrays), size[0], size[1]))
+        stages = []
+    meta = unrolled_metadata(chain_out_shape(stages, batch.shape[1:]))
+    n = int(batch.shape[0])
+    if pad_to_bucket:
+        padded, real = pad_rows(batch, bucket_rows(n))
+        dev = prep_image_batch(padded, stages, unroll=True, sharding=sharding)
+        return trim_rows(dev, real), meta
+    if max_rows is not None and n > max_rows:
+        import jax.numpy as jnp
+
+        parts = []
+        for i in range(0, n, max_rows):
+            chunk, _ = pad_rows(batch[i:i + max_rows], max_rows)
+            parts.append(
+                prep_image_batch(chunk, stages, unroll=True, sharding=sharding)
+            )
+        # only the LAST chunk carried pad rows, so one tail trim undoes it
+        return trim_rows(jnp.concatenate(parts, axis=0), n), meta
+    return prep_image_batch(batch, stages, unroll=True, sharding=sharding), meta
+
+
+def unrolled_metadata(shape_hwc: Tuple[int, int, int]) -> Dict[str, Any]:
+    """The "unrolled" column metadata consumers (TPUModel's
+    extract_feature_matrix) use to un-scramble CHW planes."""
+    h, w, c = shape_hwc
+    return {"unrolled": {"order": "CHW", "height": int(h), "width": int(w),
+                         "channels": int(c)}}

@@ -23,7 +23,7 @@ from typing import Any, List, Optional, Union
 
 import numpy as np
 
-from mmlspark_tpu.core.dataframe import DataFrame, DataType, Field
+from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType, Field
 from mmlspark_tpu.core.params import (
     ComplexParam,
     Param,
@@ -34,7 +34,6 @@ from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.dnn.network import NetworkBundle
 from mmlspark_tpu.images.transformer import (
     ResizeImageTransformer,
-    UnrollBinaryImage,
     UnrollImage,
 )
 from mmlspark_tpu.models.tpu_model import TPUModel
@@ -70,6 +69,22 @@ class ImageFeaturizer(Transformer, Wrappable):
     mini_batch_size = Param(
         "mini_batch_size", "Rows per device dispatch", TypeConverters.to_int
     )
+    fused = Param(
+        "fused",
+        "Use the fused device prep path (stack once, upload once, one XLA "
+        "resize+unroll program) when the image column is batchable; False "
+        "restores the per-row host prep",
+        TypeConverters.to_boolean,
+    )
+    dtype = Param(
+        "dtype",
+        "Compute dtype override for the inner TPUModel eval: bfloat16 "
+        "halves MXU cycle cost on TPU, float32 forces full precision (the "
+        "rollback); empty (default) inherits the bundle network's own "
+        "dtype. Feature columns stay float32 (parity gated by the zoo "
+        "bf16 tests)",
+        TypeConverters.to_string,
+    )
 
     def __init__(
         self,
@@ -85,6 +100,8 @@ class ImageFeaturizer(Transformer, Wrappable):
             cut_output_layers=1,
             drop_na=True,
             mini_batch_size=64,
+            fused=True,
+            dtype="",
         )
         if model is not None:
             self.set_model(model)
@@ -127,6 +144,12 @@ class ImageFeaturizer(Transformer, Wrappable):
     def set_mini_batch_size(self, v: int):
         return self.set(self.mini_batch_size, v)
 
+    def set_fused(self, v: bool):
+        return self.set(self.fused, v)
+
+    def set_dtype(self, v: str):
+        return self.set(self.dtype, v)
+
     # -- helpers ---------------------------------------------------------------
 
     def _effective_layer_names(self) -> List[str]:
@@ -147,6 +170,34 @@ class ImageFeaturizer(Transformer, Wrappable):
             )
         return names[cut]
 
+    # -- fused device prep -----------------------------------------------------
+
+    def _fused_unrolled(self, df: DataFrame, in_col: str,
+                        resized: str, h: int, w: int) -> Optional[DataFrame]:
+        """Device-resident prep: stack rows once on host, upload ONCE, run
+        the fused resize+unroll XLA program, emit a device-backed unrolled
+        column. Returns None when the column is not batchable (nulls,
+        mixed channel counts) — the host path then runs. Ragged source
+        shapes still qualify: they host-resize grouped by shape (one
+        resize_batch per distinct shape) and the device chain is
+        unroll-only."""
+        from mmlspark_tpu.images import device_ops
+
+        arrays = device_ops.image_row_arrays(list(df[in_col]))
+        if arrays is None:
+            return None
+        fused = device_ops.fused_unrolled_batch(
+            arrays, size=(h, w),
+            # bound the staged upload + program rows: a frame-sized batch
+            # must not become one giant h2d/XLA program (chunks share one
+            # compiled shape, device outputs concatenate)
+            max_rows=self.get(self.mini_batch_size),
+        )
+        if fused is None:
+            return None
+        dev, meta = fused
+        return df.with_column(resized, dev, DataType.VECTOR, metadata=meta)
+
     # -- stage contract --------------------------------------------------------
 
     def transform_schema(self, schema: List[Field]) -> List[Field]:
@@ -164,28 +215,42 @@ class ImageFeaturizer(Transformer, Wrappable):
                 df = df.filter(keep)
 
         dtype = df.dtype(in_col)
-        if dtype == DataType.STRUCT:
-            prepared = (
-                ResizeImageTransformer(in_col, "__prep__", height=h, width=w)
-                .transform(df)
-            )
-            unrolled = UnrollImage("__prep__", resized).transform(prepared)
-            unrolled = unrolled.drop("__prep__")
-        elif dtype == DataType.BINARY:
-            unrolled = UnrollBinaryImage(
-                in_col, resized, height=h, width=w
-            ).transform(df)
-        else:
+        if dtype not in (DataType.STRUCT, DataType.BINARY):
             raise ValueError(
                 f"input column {in_col!r} needs image STRUCT or BINARY type, "
                 f"got {dtype.value}"
             )
+        work_col = in_col
+        if dtype == DataType.BINARY:
+            # decode ONCE — the fused attempt and the host fallback read
+            # the same decoded rows (decode is the dominant host cost;
+            # falling back must not pay it twice)
+            from mmlspark_tpu.io.image import decode_image
+
+            rows = np.empty(len(df), object)
+            rows[:] = [decode_image(bytes(raw)) for raw in df[in_col]]
+            work_col = "__decoded__"
+            df = df.with_column(work_col, Column(rows, DataType.STRUCT))
+        unrolled = (
+            self._fused_unrolled(df, work_col, resized, h, w)
+            if self.get(self.fused) else None
+        )
+        if unrolled is None:
+            prepared = (
+                ResizeImageTransformer(work_col, "__prep__", height=h, width=w)
+                .transform(df)
+            )
+            unrolled = UnrollImage("__prep__", resized).transform(prepared)
+            unrolled = unrolled.drop("__prep__")
+        if work_col != in_col:
+            unrolled = unrolled.drop(work_col)
 
         inner = TPUModel(
             bundle,
             input_col=resized,
             output_col=self.get(self.output_col),
             mini_batch_size=self.get(self.mini_batch_size),
+            dtype=self.get(self.dtype),
         )
         out_layer = self._output_layer()
         if out_layer is not None:

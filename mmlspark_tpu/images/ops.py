@@ -130,6 +130,51 @@ def gaussian_kernel(img: np.ndarray, aperture_size: int, sigma: float) -> np.nda
     return np.rint(out).astype(img.dtype)
 
 
+def normalize(img: np.ndarray, mean, std, color_scale_factor: float = 1.0) -> np.ndarray:
+    """Per-channel standardization (reference ImageTransformer.normalize):
+    (img * color_scale_factor - mean) / std, broadcast over the channel
+    axis. Float-valued — a terminal prep op feeding unroll/a network, not a
+    row-materializing stage (uint8 rows cannot hold it)."""
+    im = np.asarray(img, np.float64)
+    if im.ndim == 2:
+        im = im[:, :, None]
+    mean = np.asarray(mean, np.float64)
+    std = np.asarray(std, np.float64)
+    return ((im * float(color_scale_factor) - mean) / std).astype(np.float32)
+
+
+def unroll(imgs: np.ndarray) -> np.ndarray:
+    """Uniform (N, H, W, C) batch -> (N, C*H*W) float CHW-flattened vectors
+    (the UnrollImage layout, BGR channel planes) — the oracle the fused
+    device unroll is parity-gated against."""
+    imgs = np.asarray(imgs)
+    if imgs.ndim == 3:
+        imgs = imgs[:, :, :, None]
+    return (
+        np.transpose(imgs, (0, 3, 1, 2))
+        .reshape(imgs.shape[0], -1)
+        .astype(np.float64)
+    )
+
+
+def resize_groups(imgs, height: int, width: int):
+    """Resize a ragged list of HxWxC images by grouping same-shape images
+    into resize_batch calls — the batched host fallback for call sites that
+    would otherwise loop `resize(img)` per row (decode output is ragged by
+    nature; most datasets still cluster on a few source shapes). Returns
+    per-input resized arrays in input order."""
+    arrays = [np.asarray(im) for im in imgs]
+    by_shape: dict = {}
+    for i, im in enumerate(arrays):
+        by_shape.setdefault(im.shape, []).append(i)
+    out: list = [None] * len(arrays)
+    for idx in by_shape.values():
+        batch = resize_batch(np.stack([arrays[i] for i in idx]), height, width)
+        for j, i in enumerate(idx):
+            out[i] = batch[j]
+    return out
+
+
 OPS = {
     "resize": lambda img, p: resize(img, p["height"], p["width"]),
     "crop": lambda img, p: crop(img, p["x"], p["y"], p["height"], p["width"]),

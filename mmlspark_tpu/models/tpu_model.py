@@ -198,6 +198,15 @@ class TPUModel(Model, Wrappable):
         "Shard minibatches over the data axis of the default device mesh",
         TypeConverters.to_boolean,
     )
+    dtype = Param(
+        "dtype",
+        "Compute dtype override for network evaluation: bfloat16 halves "
+        "MXU cycle cost on TPU, float32 forces full precision (the "
+        "rollback). Empty (the default) inherits the bundle network's own "
+        "compute dtype, so bf16 zoo variants stay bf16. Output columns "
+        "stay float32; parity is gated by the zoo bf16 tests",
+        TypeConverters.to_string,
+    )
 
     def __init__(
         self,
@@ -205,6 +214,7 @@ class TPUModel(Model, Wrappable):
         input_col: str = "features",
         output_col: str = "output",
         mini_batch_size: int = 128,
+        dtype: Optional[str] = None,
     ):
         super().__init__()
         self._set_defaults(
@@ -213,7 +223,10 @@ class TPUModel(Model, Wrappable):
             mini_batch_size=128,
             convert_output_to_dense_vector=True,
             use_mesh=False,
+            dtype="",
         )
+        if dtype:
+            self.set(self.dtype, dtype)
         if model is not None:
             self.set_model(model)
         self.set(self.input_col, input_col)
@@ -242,6 +255,9 @@ class TPUModel(Model, Wrappable):
     def set_output_layer(self, value: str):
         return self.set(self.output_layer, value)
 
+    def set_dtype(self, value: str):
+        return self.set(self.dtype, value)
+
     def set_feed_dict(self, feed: dict) -> "TPUModel":
         """Reference feedDict {input var: column}; single-input networks."""
         if len(feed) != 1:
@@ -264,6 +280,13 @@ class TPUModel(Model, Wrappable):
         net = self.get_model().network
         if self.is_set(self.output_layer):
             net = net.truncate_at(self.get(self.output_layer))
+        want = self.get(self.dtype)  # "" = inherit the network's own dtype
+        if want and want != net.compute_dtype:
+            # dtype variants share the bundle's variables (weights stay f32
+            # in HBM; layers cast per-op) but compile distinct programs —
+            # _forward_key includes compute_dtype, so the dispatch cache
+            # keeps them apart
+            net = Network(net.spec, net.input_shape, want)
         return net
 
     def _eval_batches(self, x) -> Any:

@@ -35,8 +35,10 @@ from mmlspark_tpu.serving.fabric import (
     ServingFabric,
 )
 from mmlspark_tpu.serving.faults import FaultInjector
+from mmlspark_tpu.serving.image import ImageServingHandler
 
 __all__ = [
+    "ImageServingHandler",
     "AdmissionController",
     "CircuitBreaker",
     "DistributedServingServer",

@@ -1,0 +1,188 @@
+"""Image scoring over HTTP: the fused device prep path behind a staged
+serving handler.
+
+`ImageServingHandler` is the image-tier `PipelineServingHandler`: requests
+carry either base64-encoded image bytes (``{"image": "<b64 jpeg/png/npy>"}``)
+or a nested pixel array (``{"pixels": [[[...]]]}`` — HWC uint8-ranged, BGR
+like every image column). The three stages split exactly along the PR 4
+contract:
+
+- **parse** (thread pool, no lock): base64 + image decode — inherently
+  host work — then ragged decode shapes host-resize grouped by shape
+  (ops.resize_groups: one resize_batch per distinct source shape, never a
+  per-row loop) and the uniform uint8 batch goes through
+  `device_ops.prep_image_batch`: ONE h2d upload, one fused XLA
+  resize/unroll program, a device-backed "unrolled" column. Rows that fail
+  to decode get a zero-image placeholder plus a MALFORMED_COL marker.
+- **score** (model lock): TPUModel dispatch only — the input column is
+  already device-resident, so the critical section moves zero bytes over
+  the host link (the same transfer-guard discipline bench.run_serving_smoke
+  gates).
+- **reply** (thread pool): the one d2h sync + JSON serialization via
+  make_reply.
+
+``dtype="bfloat16"`` flips the inner TPUModel to the bf16 program (shared
+weight upload, half MXU cycle cost; parity gated by the zoo bf16 tests).
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+from typing import Any, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.dnn.network import NetworkBundle
+from mmlspark_tpu.models.tpu_model import TPUModel
+from mmlspark_tpu.serving.server import (
+    MALFORMED_COL,
+    StagedServingHandler,
+    make_reply,
+)
+
+UNROLLED_COL = "unrolled"
+
+
+class ImageServingHandler(StagedServingHandler):
+    """Serve a zoo/network bundle over image requests through the fused
+    device prep path (one upload + one XLA prep program per batch).
+
+    Parameters
+    ----------
+    bundle: the NetworkBundle to score; its `input_shape` (H, W, C) is the
+        prep target every request is resized to.
+    value_col / id_col: output column / request-id column names.
+    output_layer: named layer to fetch (headless featurization), optional.
+    mini_batch_size: rows per device dispatch of the inner TPUModel.
+    dtype: "bfloat16" / "float32" override; None (default) inherits the
+        bundle network's own compute dtype.
+    """
+
+    def __init__(
+        self,
+        bundle: NetworkBundle,
+        value_col: str = "scored",
+        id_col: str = "id",
+        output_layer: Optional[str] = None,
+        mini_batch_size: int = 64,
+        dtype: Optional[str] = None,
+    ):
+        self.bundle = bundle
+        self.value_col = value_col
+        self.id_col = id_col
+        self.in_shape = tuple(int(d) for d in bundle.network.input_shape)
+        if len(self.in_shape) != 3:
+            raise ValueError(
+                f"ImageServingHandler needs an image network (H, W, C) "
+                f"input, got {self.in_shape}"
+            )
+        self.model = TPUModel(
+            bundle,
+            input_col=UNROLLED_COL,
+            output_col=value_col,
+            mini_batch_size=mini_batch_size,
+            dtype=dtype,
+        )
+        if output_layer:
+            self.model.set_output_layer(output_layer)
+
+    # -- per-row host decode (the one inherently-host step) -------------------
+
+    def _decode_row(self, obj: Any) -> Any:
+        """Request JSON object -> HWC uint8 ndarray, or an error string."""
+        from mmlspark_tpu.io.image import DECODE_ERRORS, decode_image
+
+        if not isinstance(obj, dict):
+            return "request body must be a JSON object"
+        if obj.get("image") is not None:
+            try:
+                raw = base64.b64decode(obj["image"], validate=True)
+                img = np.asarray(decode_image(raw)["data"])
+            except (binascii.Error, TypeError, *DECODE_ERRORS) as e:
+                return f"field 'image': undecodable ({e})"
+        elif obj.get("pixels") is not None:
+            try:
+                img = np.asarray(obj["pixels"], np.float64)
+            except (TypeError, ValueError):
+                return "field 'pixels': not a numeric array"
+            if img.ndim == 2:
+                img = img[:, :, None]
+            if img.ndim != 3:
+                return f"field 'pixels': expected HWC array, got ndim={img.ndim}"
+            img = np.clip(np.rint(img), 0, 255).astype(np.uint8)
+        else:
+            return "need field 'image' (base64 bytes) or 'pixels' (HWC array)"
+        if img.ndim == 2:
+            img = img[:, :, None]
+        h, w, c = self.in_shape
+        if img.shape[2] != c:
+            if img.shape[2] == 4 and c == 3:  # drop alpha
+                img = img[:, :, :3]
+            elif img.shape[2] == 1 and c == 3:  # gray -> 3-plane
+                img = np.repeat(img, 3, axis=2)
+            else:
+                return (
+                    f"image has {img.shape[2]} channels, model wants {c}"
+                )
+        return img
+
+    # -- staged contract -------------------------------------------------------
+
+    def parse(self, df: DataFrame) -> DataFrame:
+        import json
+
+        from mmlspark_tpu.images import device_ops
+
+        requests = list(df.column("request").values)
+        ids = df.column(self.id_col).values
+        h, w, c = self.in_shape
+        if not requests:
+            out = DataFrame.from_dict({self.id_col: np.asarray(ids, object)})
+            return out.with_column(
+                UNROLLED_COL, np.zeros((0, h * w * c), np.float32),
+                DataType.VECTOR,
+            )
+        errors: List[Optional[str]] = [None] * len(requests)
+        imgs: List[Optional[np.ndarray]] = []
+        for i, r in enumerate(requests):
+            body = r.entity.string_content if r and r.entity else ""
+            try:
+                obj = json.loads(body) if body else {}
+            except json.JSONDecodeError:
+                obj = None
+            decoded = self._decode_row(obj)
+            if isinstance(decoded, str):
+                errors[i] = decoded
+                imgs.append(None)
+            else:
+                imgs.append(decoded)
+        # malformed rows ride along as zero images (placeholder rows keep
+        # the batch rectangular; make_reply turns their markers into 400s)
+        filled = [
+            im if im is not None else np.zeros((h, w, c), np.uint8)
+            for im in imgs
+        ]
+        # shared uniform/ragged dispatch: one upload + the fused unroll
+        # program, row count padded to a power-of-two bucket so the
+        # coalescer's many distinct batch sizes reuse a handful of compiled
+        # programs instead of tracing per exact N; cannot return None
+        # because _decode_row pinned every row (and every placeholder) to
+        # the model's channel count c
+        dev, meta = device_ops.fused_unrolled_batch(
+            filled, size=(h, w), pad_to_bucket=True
+        )
+        out = DataFrame.from_dict({self.id_col: np.asarray(ids, object)})
+        out = out.with_column(UNROLLED_COL, dev, DataType.VECTOR, metadata=meta)
+        if any(e is not None for e in errors):
+            marker = np.empty(len(errors), object)
+            marker[:] = errors
+            out = out.with_column(MALFORMED_COL, marker)
+        return out
+
+    def score(self, df: DataFrame) -> DataFrame:
+        return self.model.transform(df)
+
+    def reply(self, df: DataFrame) -> DataFrame:
+        return make_reply(df, self.value_col)

@@ -1,0 +1,50 @@
+"""Fixture for the host-roundtrip-in-batch-loop rule: per-row numpy/image-op
+compute over a column's rows inside Python loops. Parsed, never imported."""
+
+import numpy as np
+
+from mmlspark_tpu.images import ops
+
+
+class BadPerRowStage:
+    def transform(self, df):
+        values = df[self.get(self.input_col)]
+        out = []
+        for row in values:
+            out.append(ops.resize(row, 224, 224))  # expect[host-roundtrip-in-batch-loop]
+        for i, row in enumerate(values):
+            out[i] = np.rint(row * 0.5)  # expect[host-roundtrip-in-batch-loop]
+        flipped = [ops.flip(v, 1) for v in values]  # expect[host-roundtrip-in-batch-loop]
+        col_vals = df.column("pixels").values
+        for v in col_vals:
+            out.append(np.transpose(v, (2, 0, 1)))  # expect[host-roundtrip-in-batch-loop]
+        # nested per-row calls report once, at the outermost op
+        for v in values:
+            out.append(ops.resize(np.asarray(v), 8, 8))  # expect[host-roundtrip-in-batch-loop]
+        return out, flipped
+
+    def alias_bound_in_nested_block(self, df, cond):
+        # the pull happens inside a nested block, the alias is read at the
+        # outer level AFTER it — walk order alone would miss the taint
+        if cond:
+            vals = df["image"]
+        else:
+            vals = df["thumb"]
+        rows = vals
+        return [ops.resize(r, 4, 4) for r in rows]  # expect[host-roundtrip-in-batch-loop]
+
+    def clean_paths(self, df):
+        values = df[self.get(self.input_col)]
+        # converters/collectors per row are the FIX (stage rows for ONE
+        # batched call), not the bug
+        arrays = [np.asarray(v["data"]) for v in values]
+        batch = np.stack(arrays)
+        resized = ops.resize_batch(batch, 224, 224)  # batched: clean
+        grouped = ops.resize_groups(arrays, 64, 64)  # tainted arg, no row: clean
+        # loops over non-column iterables are out of scope
+        for chunk in [np.zeros((2, 4)), np.ones((2, 4))]:
+            _ = np.rint(chunk)
+        # a justified per-row loop (mixed per-row params) is suppressible
+        for i, row in enumerate(values):
+            _ = ops.crop(row, 0, 0, i + 1, i + 1)  # graftcheck: ignore[host-roundtrip-in-batch-loop]  # expect-suppressed[host-roundtrip-in-batch-loop]
+        return resized, grouped

@@ -141,6 +141,61 @@ def test_host_sync_rule_ignores_non_transform_functions():
     assert all(f.line < fit_line for f in findings)
 
 
+# -- batch loop ---------------------------------------------------------------
+
+
+def test_host_roundtrip_in_batch_loop_fires_and_suppresses():
+    from mmlspark_tpu.analysis.batch_loop import check_batch_loop
+
+    path = os.path.join(FIXTURES, "batch_loop_bad.py")
+    findings = check_batch_loop([path], repo_root=FIXTURES)
+    _assert_matches_markers("batch_loop_bad.py", findings)
+
+
+def test_batch_loop_rule_allows_converters_and_batched_calls():
+    from mmlspark_tpu.analysis.batch_loop import check_batch_loop
+
+    path = os.path.join(FIXTURES, "batch_loop_bad.py")
+    findings = check_batch_loop([path], repo_root=FIXTURES)
+    # nothing in the clean_paths section fires except the suppressed line:
+    # np.asarray/np.stack per row (the staging-for-one-batched-call idiom),
+    # batched ops on the whole stack, and non-column loops are all clean
+    with open(path) as f:
+        clean_line = next(
+            i for i, line in enumerate(f, start=1) if "def clean_paths" in line
+        )
+    suppressed = {
+        line for line, rule in _expectations("batch_loop_bad.py")[1]
+    }
+    assert all(
+        f.line < clean_line or f.line in suppressed for f in findings
+    ), findings
+
+
+def test_batch_loop_rule_scoped_to_image_tiers(tmp_path):
+    """run_all only feeds images/featurize/stages modules to the rule: the
+    same per-row pattern in, say, serving/ is out of scope."""
+    pkg = tmp_path / "mmlspark_tpu"
+    bad_src = (
+        "import numpy as np\nfrom mmlspark_tpu.images import ops\n\n"
+        "def transform(df):\n"
+        "    values = df['image']\n"
+        "    return [ops.resize(v, 4, 4) for v in values]\n"
+    )
+    for sub in ("images", "serving"):
+        d = pkg / sub
+        d.mkdir(parents=True)
+        (d / "__init__.py").write_text("")
+        (d / "mod.py").write_text(bad_src)
+    (pkg / "__init__.py").write_text("")
+    findings = run_all(
+        root=str(tmp_path), select=["host-roundtrip-in-batch-loop"]
+    )
+    paths = {f.path for f in findings}
+    assert os.path.join("mmlspark_tpu", "images", "mod.py") in paths
+    assert not any("serving" in p for p in paths), paths
+
+
 # -- lock scope ---------------------------------------------------------------
 
 
