@@ -1,0 +1,152 @@
+"""Atomic-artifact-write rule: no in-place writes to final artifact paths.
+
+``non-atomic-artifact-write`` flags ``open(path, "w"/"wb"/"a"/"ab")`` inside
+the persistence tier (``io/``, ``core/serialize``, ``dnn/network``,
+``gbdt/booster`` — the modules whose files ARE the durable artifacts) when
+the write lacks the tmp+rename discipline: a crash mid-write at a final
+path destroys the previous good artifact and leaves a torn file the loader
+may half-trust. Exactly the bug `Booster.save_native_model` and
+`Network.save_to_dir` shipped with until ISSUE 8 routed them through
+`io/checkpoint.atomic_write_*` / `publish_dir` (docs/persistence.md).
+
+A write is considered disciplined (clean) when either:
+
+- the path expression mentions a tmp-staged name — any identifier
+  containing ``tmp`` (``tmp``, ``tmp_dir``, ``tmp_path``...) or a
+  ``tempfile.*`` call — the "write into the staging dir" half of the
+  protocol, or
+- the enclosing function also calls ``os.replace`` (or
+  ``io/checkpoint``'s ``replace_path``/``publish_dir``/
+  ``atomic_write_bytes``/``atomic_write_text``) — the "publish atomically"
+  half, evidence the function implements the discipline locally.
+
+Detection is lexical, like the network-timeout rule: aliasing ``open``
+through a variable is not followed, and renaming a final path to carry
+``tmp`` in its name defeats the rule — the reviewer owns that lie. A
+justified in-place write (e.g. a fault injector deliberately tearing a
+file) takes ``# graftcheck: ignore[non-atomic-artifact-write]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional
+
+from mmlspark_tpu.analysis.base import Finding
+
+_RULE = "non-atomic-artifact-write"
+_WRITE_MODES = ("w", "wb", "a", "ab", "w+", "wb+", "a+", "ab+", "r+b", "r+")
+#: calls that publish a staged write atomically — their presence in the
+#: enclosing function marks it as implementing the discipline
+_PUBLISH_CALLS = {
+    "replace", "replace_path", "publish_dir", "staged_dir",
+    "atomic_write_bytes", "atomic_write_text",
+}
+
+
+def _callee_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _write_mode(node: ast.Call) -> Optional[str]:
+    """The literal mode of an open() call, or None when unknown/read."""
+    mode: Optional[ast.AST] = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return None  # default "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        m = mode.value.replace("t", "")
+        return m if m in _WRITE_MODES else None
+    return None  # dynamic mode: don't guess
+
+
+def _mentions_tmp(expr: ast.AST) -> bool:
+    """True when the path expression names anything tmp-staged."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) and "tmp" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "tmp" in sub.attr.lower():
+            return True
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and "tmp" in sub.value.lower():
+            return True
+    return False
+
+
+def _has_publish_call(func_node: ast.AST) -> bool:
+    for sub in ast.walk(func_node):
+        if isinstance(sub, ast.Call):
+            name = _callee_name(sub.func)
+            if name not in _PUBLISH_CALLS:
+                continue
+            if name == "replace":
+                # only os.replace is a publish; str.replace and friends
+                # share the trailing name but publish nothing
+                if not (isinstance(sub.func, ast.Attribute)
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == "os"):
+                    continue
+            return True
+    return False
+
+
+def check_atomic_write(
+    paths: Iterable[str], repo_root: Optional[str] = None
+) -> List[Finding]:
+    repo_root = repo_root or os.getcwd()
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, repo_root)
+        # innermost-function resolution: walk functions, remember each open()
+        # call's nearest enclosing def so the publish-call heuristic scopes
+        # to the function actually doing the write
+        funcs = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+
+        def enclosing(call: ast.Call) -> Optional[ast.AST]:
+            best = None
+            for fn in funcs:
+                if (fn.lineno <= call.lineno
+                        and call.lineno <= (fn.end_lineno or fn.lineno)):
+                    if best is None or fn.lineno > best.lineno:
+                        best = fn
+            return best
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _callee_name(node.func) != "open" or not node.args:
+                continue
+            if _write_mode(node) is None:
+                continue
+            target = node.args[0]
+            if _mentions_tmp(target):
+                continue  # staging-dir half of the discipline
+            fn = enclosing(node)
+            if fn is not None and _has_publish_call(fn):
+                continue  # publish half present in the same function
+            findings.append(Finding(
+                _RULE, rel, node.lineno,
+                "open() writes a final artifact path in place; a crash "
+                "mid-write destroys the previous good artifact — stage in "
+                "a tmp sibling and publish with os.replace "
+                "(io/checkpoint.atomic_write_* / publish_dir)",
+            ))
+    return findings

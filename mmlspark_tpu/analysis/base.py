@@ -34,6 +34,8 @@ RULES: Dict[str, str] = {
     "non-monotonic-duration": "time.time() feeding a duration/deadline computation; use time.monotonic/perf_counter",
     # net-timeout family (net_timeout.py)
     "network-call-no-timeout": "HTTPConnection/socket.create_connection without timeout= blocks on a dead peer for the OS TCP default",
+    # atomic-write family (atomic_write.py)
+    "non-atomic-artifact-write": "open(path, 'w'/'wb') on a final artifact path in a persistence module without the tmp+rename discipline; a crash mid-write destroys the previous good artifact",
     # Params-contract family (params_contract.py)
     "param-converter": "simple Param declared without an explicit type converter",
     "param-doc": "stage or Param missing documentation",

@@ -117,6 +117,25 @@ def run_all(
         from mmlspark_tpu.analysis.net_timeout import check_net_timeout
 
         findings += check_net_timeout(package_files, repo_root=root)
+    if "non-atomic-artifact-write" in enabled:
+        from mmlspark_tpu.analysis.atomic_write import check_atomic_write
+
+        # scoped to the persistence tier: the modules whose on-disk files
+        # ARE the durable artifacts (ISSUE 8; docs/persistence.md)
+        persist_prefix = os.path.join(package_name, "io") + os.sep
+        persist_files = {
+            os.path.join(package_name, "core", "serialize.py"),
+            os.path.join(package_name, "dnn", "network.py"),
+            os.path.join(package_name, "gbdt", "booster.py"),
+        }
+        findings += check_atomic_write(
+            [
+                p for p in package_files
+                if os.path.relpath(p, root).startswith(persist_prefix)
+                or os.path.relpath(p, root) in persist_files
+            ],
+            repo_root=root,
+        )
     if enabled & _PARAM_RULES:
         from mmlspark_tpu.analysis.params_contract import check_params_contract
 

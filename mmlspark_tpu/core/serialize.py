@@ -27,8 +27,6 @@ import inspect
 import json
 import os
 import pickle
-import shutil
-import tempfile
 from typing import Any, Dict
 
 import numpy as np
@@ -59,27 +57,22 @@ def _resolve_class(path: str):
 
 
 def save_stage(stage: Params, path: str, overwrite: bool = False) -> None:
+    from mmlspark_tpu.io.checkpoint import staged_dir
+
     if os.path.exists(path) and not overwrite:
         raise FileExistsError(f"{path} exists; pass overwrite=True")
-    # Write the whole save into a sibling temp dir first, then swap it in, so
-    # a mid-save failure (e.g. a non-serializable param) never destroys a
-    # previous good save at `path`.
-    parent = os.path.dirname(os.path.abspath(path)) or "."
-    os.makedirs(parent, exist_ok=True)
-    # Unique temp dir so concurrent saves to the same path can't corrupt each
-    # other mid-write; the final os.replace is the only shared step.
-    tmp = tempfile.mkdtemp(prefix=os.path.basename(path) + ".tmp_save_", dir=parent)
-    try:
+    # The whole save is built in a unique sibling staging dir and swapped
+    # in atomically with every file fsynced first (io/checkpoint.staged_dir)
+    # — a mid-save failure never destroys a previous good save at `path`,
+    # and tmp+os.replace alone would NOT be durable across power loss (the
+    # rename can land while the data blocks it points at never did).
+    with staged_dir(path) as tmp:
         _write_stage(stage, tmp)
-    except BaseException:
-        shutil.rmtree(tmp, ignore_errors=True)
-        raise
-    if os.path.exists(path):
-        shutil.rmtree(path)
-    os.replace(tmp, path)
 
 
-def _write_stage(stage: Params, path: str) -> None:
+def _write_stage(stage: Params, tmp_path: str) -> None:
+    # `tmp_path` by contract: always a staging dir save_stage later
+    # publishes atomically — writes here are never visible at a final path.
     meta: Dict[str, Any] = {
         "class": _class_path(stage),
         "version": _FORMAT_VERSION,
@@ -89,7 +82,7 @@ def _write_stage(stage: Params, path: str) -> None:
         "complex_defaults": {},
         "init_args": {},
     }
-    complex_dir = os.path.join(path, "complex")
+    complex_dir = os.path.join(tmp_path, "complex")
     # Persist the default param map too (reference serializes defaultParamMap:
     # ComplexParamsSerializer semantics) so stages whose __init__ takes
     # required args still round-trip their defaults.
@@ -114,13 +107,31 @@ def _write_stage(stage: Params, path: str) -> None:
         for name, value in stage._init_args().items():
             os.makedirs(complex_dir, exist_ok=True)
             meta["init_args"][name] = _save_complex(value, complex_dir, f"_init_{name}")
-    with open(os.path.join(path, "metadata.json"), "w") as f:
+    with open(os.path.join(tmp_path, "metadata.json"), "w") as f:
         json.dump(meta, f, indent=1, sort_keys=True)
 
 
 def load_stage(path: str) -> Params:
-    with open(os.path.join(path, "metadata.json")) as f:
-        meta = json.load(f)
+    from mmlspark_tpu.io.checkpoint import CorruptArtifactError
+
+    recovery = (
+        "Re-save the stage, or restore the directory from a backup/"
+        "checkpoint generation. The atomic save protocol means a crash "
+        "mid-save preserves the previous good artifact at this path — a "
+        "missing or truncated metadata.json indicates the directory was "
+        "built by hand or damaged after the fact."
+    )
+    try:
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        raise CorruptArtifactError(
+            path, "not a stage directory: metadata.json is missing", recovery
+        ) from None
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CorruptArtifactError(
+            path, f"metadata.json is truncated or garbled ({e})", recovery
+        ) from None
     cls = _resolve_class(meta["class"])
     stage = cls.__new__(cls)
     Params.__init__(stage)
@@ -180,31 +191,42 @@ def _json_keys_safe(value: Any) -> bool:
     return True
 
 
-def _save_complex(value: Any, directory: str, name: str) -> str:
+def _save_complex(value: Any, tmp_dir: str, name: str) -> str:
+    # `tmp_dir` by contract: the complex/ dir of a STAGED save
+    # (save_stage's tmp), so direct writes here never touch a final path.
+    # Nested stages/frames write STRAIGHT into the outer staging tree
+    # (_write_stage/_write_dataframe, no per-child staging+publish): the
+    # outermost save's single fsync pass + atomic swap covers the whole
+    # tree, so per-child durability dances would only multiply fsyncs.
     if isinstance(value, list) and value and all(isinstance(v, Params) for v in value):
-        sub = os.path.join(directory, name)
-        os.makedirs(sub, exist_ok=True)
-        with open(os.path.join(sub, "_list.json"), "w") as f:
+        tmp_sub = os.path.join(tmp_dir, name)
+        os.makedirs(tmp_sub, exist_ok=True)
+        with open(os.path.join(tmp_sub, "_list.json"), "w") as f:
             json.dump({"n": len(value)}, f)
         for i, stage in enumerate(value):
-            save_stage(stage, os.path.join(sub, str(i)))
+            child = os.path.join(tmp_sub, str(i))
+            os.makedirs(child, exist_ok=True)
+            _write_stage(stage, child)
         return "stage_list"
     if isinstance(value, Params):
-        save_stage(value, os.path.join(directory, name))
+        child = os.path.join(tmp_dir, name)
+        os.makedirs(child, exist_ok=True)
+        _write_stage(value, child)
         return "stage"
     if isinstance(value, DataFrame):
-        sub = os.path.join(directory, name)
-        save_dataframe(value, sub)
+        child = os.path.join(tmp_dir, name)
+        os.makedirs(child, exist_ok=True)
+        _write_dataframe(value, child)
         return "dataframe"
     if isinstance(value, np.ndarray):
-        np.save(os.path.join(directory, f"{name}.npy"), value, allow_pickle=False)
+        np.save(os.path.join(tmp_dir, f"{name}.npy"), value, allow_pickle=False)
         return "ndarray"
     if (
         isinstance(value, dict)
         and all(isinstance(k, str) for k in value)  # np.savez(**) needs str keys
         and all(isinstance(v, np.ndarray) for v in value.values())
     ):
-        np.savez(os.path.join(directory, f"{name}.npz"), **value)
+        np.savez(os.path.join(tmp_dir, f"{name}.npz"), **value)
         return "ndarray_dict"
     if isinstance(value, (str, int, float, bool, list, dict, type(None))):
         # json.dump silently STRINGIFIES non-str dict keys (float 1.0 ->
@@ -212,19 +234,24 @@ def _save_complex(value: Any, directory: str, name: str) -> str:
         # only JSON-encode values that round-trip exactly
         if _json_keys_safe(value):
             try:
-                with open(os.path.join(directory, f"{name}.json"), "w") as f:
+                with open(os.path.join(tmp_dir, f"{name}.json"), "w") as f:
                     json.dump(value, f)
                 return "json"
             except TypeError:
                 pass
     if hasattr(value, "save_to_dir") and hasattr(type(value), "load_from_dir"):
-        sub = os.path.join(directory, name)
-        os.makedirs(sub, exist_ok=True)
-        with open(os.path.join(sub, "_custom.json"), "w") as f:
+        tmp_sub = os.path.join(tmp_dir, name)
+        # protocol guarantee kept from before ISSUE 8: the target dir
+        # exists when save_to_dir runs (external custom classes rely on it)
+        os.makedirs(tmp_sub, exist_ok=True)
+        # save_to_dir first: directory-replacing implementations (Network)
+        # atomically swap tmp_sub, so the marker must be written after
+        value.save_to_dir(tmp_sub)
+        os.makedirs(tmp_sub, exist_ok=True)
+        with open(os.path.join(tmp_sub, "_custom.json"), "w") as f:
             json.dump({"class": _class_path(value)}, f)
-        value.save_to_dir(sub)
         return "custom"
-    with open(os.path.join(directory, f"{name}.pkl"), "wb") as f:
+    with open(os.path.join(tmp_dir, f"{name}.pkl"), "wb") as f:
         pickle.dump(value, f)
     return "pickle"
 
@@ -262,7 +289,18 @@ def _load_complex(kind: str, directory: str, name: str) -> Any:
 
 
 def save_dataframe(df: DataFrame, path: str) -> None:
-    os.makedirs(path, exist_ok=True)
+    # Atomic like save_stage: staged in a tmp sibling, swapped in whole, so
+    # a crash mid-save never leaves a schema.json/npz torn hybrid or
+    # destroys a previous good frame at `path`.
+    from mmlspark_tpu.io.checkpoint import staged_dir
+
+    with staged_dir(path) as tmp:
+        _write_dataframe(df, tmp)
+
+
+def _write_dataframe(df: DataFrame, tmp_path: str) -> None:
+    # `tmp_path` by contract: a staging dir published atomically by the
+    # caller (save_dataframe's staged_dir, or an enclosing stage save).
     numeric = {}
     objects = {}
     meta = {"fields": [], "num_partitions": df.num_partitions, "n": len(df)}
@@ -276,11 +314,11 @@ def save_dataframe(df: DataFrame, path: str) -> None:
         else:
             numeric[field.name] = col.values
     if numeric:
-        np.savez(os.path.join(path, "numeric.npz"), **numeric)
+        np.savez(os.path.join(tmp_path, "numeric.npz"), **numeric)
     if objects:
-        with open(os.path.join(path, "objects.pkl"), "wb") as f:
+        with open(os.path.join(tmp_path, "objects.pkl"), "wb") as f:
             pickle.dump({k: list(v) for k, v in objects.items()}, f)
-    with open(os.path.join(path, "schema.json"), "w") as f:
+    with open(os.path.join(tmp_path, "schema.json"), "w") as f:
         json.dump(meta, f, indent=1)
 
 

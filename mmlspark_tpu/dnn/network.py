@@ -536,20 +536,37 @@ class Network:
     # -- persistence (serialize.py "custom" protocol) --------------------------
 
     def save_to_dir(self, path: str, variables: Optional[dict] = None) -> None:
-        os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "spec.json"), "w") as f:
-            json.dump(
-                {
-                    "spec": self.spec,
-                    "input_shape": list(self.input_shape),
-                    "compute_dtype": self.compute_dtype,
-                },
-                f,
-                indent=1,
-            )
-        if variables is not None:
-            flat = _flatten_tree(variables)
-            np.savez(os.path.join(path, "variables.npz"), **flat)
+        # Crash-consistent save: the whole directory is staged in a tmp
+        # sibling and atomically swapped in (io/checkpoint.staged_dir), so
+        # a kill mid-save can never destroy a previous good model dir or
+        # leave a spec.json/variables.npz torn hybrid.
+        import shutil
+
+        from mmlspark_tpu.io.checkpoint import staged_dir
+
+        with staged_dir(path) as tmp_dir:
+            with open(os.path.join(tmp_dir, "spec.json"), "w") as f:
+                json.dump(
+                    {
+                        "spec": self.spec,
+                        "input_shape": list(self.input_shape),
+                        "compute_dtype": self.compute_dtype,
+                    },
+                    f,
+                    indent=1,
+                )
+            if variables is not None:
+                flat = _flatten_tree(variables)
+                np.savez(os.path.join(tmp_dir, "variables.npz"), **flat)
+            else:
+                # spec-only overwrite keeps its pre-ISSUE-8 merge
+                # semantics: existing weights at `path` survive the
+                # atomic swap by riding the staging dir
+                old_vars = os.path.join(path, "variables.npz")
+                if os.path.exists(old_vars):
+                    shutil.copy2(
+                        old_vars, os.path.join(tmp_dir, "variables.npz")
+                    )
 
     @classmethod
     def load_from_dir(cls, path: str) -> "Network":

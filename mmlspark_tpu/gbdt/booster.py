@@ -523,10 +523,12 @@ class Booster:
     def save_native_model(self, path: str, overwrite: bool = True) -> None:
         import os
 
+        from mmlspark_tpu.io.checkpoint import atomic_write_text
+
         if os.path.exists(path) and not overwrite:
             raise FileExistsError(path)
-        with open(path, "w") as f:
-            f.write(self.model_to_string())
+        # atomic: a crash mid-save leaves the previous model file intact
+        atomic_write_text(path, self.model_to_string())
 
     @classmethod
     def load_native_model(cls, path: str) -> "Booster":
@@ -538,9 +540,12 @@ class Booster:
     def save_to_dir(self, path: str) -> None:
         import os
 
+        from mmlspark_tpu.io.checkpoint import atomic_write_text
+
         os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "model.txt"), "w") as f:
-            f.write(self.model_to_string())
+        atomic_write_text(
+            os.path.join(path, "model.txt"), self.model_to_string()
+        )
 
     @classmethod
     def load_from_dir(cls, path: str) -> "Booster":

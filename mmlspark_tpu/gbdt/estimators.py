@@ -125,6 +125,23 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
     top_rate = Param("top_rate", "GOSS large-gradient keep fraction", TypeConverters.to_float)
     other_rate = Param("other_rate", "GOSS small-gradient sample fraction", TypeConverters.to_float)
     prediction_col = Param("prediction_col", "Output prediction column", TypeConverters.to_string)
+    checkpoint_dir = Param(
+        "checkpoint_dir",
+        "Crash-consistent checkpoint store directory: boosting commits "
+        "ensemble state every checkpoint_every rounds and a killed fit "
+        "resumes bit-identically from the last good generation (unset: off)",
+        TypeConverters.to_string,
+    )
+    checkpoint_every = Param(
+        "checkpoint_every",
+        "Boosting rounds between checkpoint commits",
+        TypeConverters.to_int,
+    )
+    checkpoint_keep_last = Param(
+        "checkpoint_keep_last",
+        "Checkpoint generations retained per store (older ones are deleted)",
+        TypeConverters.to_int,
+    )
 
     def _set_shared_defaults(self) -> None:
         self._set_defaults(
@@ -159,6 +176,8 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
             skip_drop=0.5,
             top_rate=0.2,
             other_rate=0.1,
+            checkpoint_every=10,
+            checkpoint_keep_last=3,
         )
 
     def _train_config(self, categorical_indexes: List[int]) -> TrainConfig:
@@ -227,12 +246,19 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
         meta = df.metadata(fcol)
         if meta.get("ml_attr", {}).get("names"):
             feature_names = list(meta["ml_attr"]["names"])
+        ckpt_dir = (
+            self.get(self.checkpoint_dir)
+            if self.is_set(self.checkpoint_dir) else None
+        )
         return train_booster(
             x, y, objective,
             self._train_config(self._categorical_indexes(df)),
             sample_weight=w, valid_mask=valid_mask,
             init_model=init_model, feature_names=feature_names,
             init_raw=init_raw,
+            checkpoint_dir=ckpt_dir,
+            checkpoint_every=self.get(self.checkpoint_every),
+            checkpoint_keep_last=self.get(self.checkpoint_keep_last),
         )
 
 

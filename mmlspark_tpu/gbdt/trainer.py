@@ -139,11 +139,31 @@ def train_booster(
     init_model: Optional[Booster] = None,
     feature_names: Optional[List[str]] = None,
     init_raw: Optional[np.ndarray] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 10,
+    checkpoint_keep_last: int = 3,
+    _resume_state: Optional[Dict[str, Any]] = None,
+    _capture_resume_state: bool = False,
 ) -> Booster:
     import jax
     import jax.numpy as jnp
 
     from mmlspark_tpu.gbdt.compute import add_leaf_outputs
+
+    if checkpoint_dir:
+        # Crash-consistent per-K-rounds checkpointing: the boosting loop is
+        # driven in `checkpoint_every`-iteration segments, each committing
+        # (model text, raw scores, rng states) to a CheckpointStore so a
+        # killed fit warm-starts from the last good generation with
+        # bit-identical trees (docs/persistence.md).
+        return _train_booster_checkpointed(
+            x, y, objective, cfg,
+            sample_weight=sample_weight, valid_mask=valid_mask,
+            init_model=init_model, feature_names=feature_names,
+            init_raw=init_raw, checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            checkpoint_keep_last=checkpoint_keep_last,
+        )
 
     log = get_logger("mmlspark_tpu.gbdt")
     x = np.asarray(x, np.float64)
@@ -245,7 +265,23 @@ def train_booster(
     # raw scores over ALL rows (valid rows ride along for eval)
     init_score = objective.init_score(y[train_rows], None if sample_weight is None
                                       else sample_weight[train_rows])
-    if init_model is not None:
+    if _resume_state is not None and _resume_state.get("raw") is not None:
+        # Checkpoint resume / segment continuation: the EXACT float32 raw
+        # scores the previous segment ended with — recomputing them via
+        # init_model.predict_raw would change summation order and shift
+        # bins on argmax ties, breaking bit-parity with the uninterrupted
+        # fit. Pad rows carry zeros: they are train_rows-masked everywhere.
+        r = np.asarray(_resume_state["raw"], np.float32)
+        if pad:
+            r = np.concatenate(
+                [r, np.zeros((pad,) + r.shape[1:], np.float32)]
+            )
+        raw = shard(r)
+        init_score = (
+            init_model.init_score if init_model is not None
+            else np.zeros(k, np.float64)
+        )
+    elif init_model is not None:
         raw_np0 = init_model.predict_raw(x).astype(np.float32)
         if init_raw is not None:
             # dataset init_score composes with continued training: base
@@ -314,6 +350,13 @@ def train_booster(
 
     rng = np.random.default_rng(cfg.bagging_seed)
     frng = np.random.default_rng(cfg.bagging_seed + 17)
+    if _resume_state is not None:
+        # continue the bagging/feature-fraction draw sequences exactly
+        # where the previous segment left them
+        if _resume_state.get("rng_state") is not None:
+            rng.bit_generator.state = _resume_state["rng_state"]
+        if _resume_state.get("frng_state") is not None:
+            frng.bit_generator.state = _resume_state["frng_state"]
 
     def bag_draw() -> np.ndarray:
         # (n,) uniform draw whose values on real rows don't depend on the
@@ -501,7 +544,7 @@ def train_booster(
                 unpack_tree(row, cfg.num_leaves, num_bins_static,
                             binner.threshold_value, grow_cfg)
             )
-        return Booster(
+        booster = Booster(
             trees,
             objective.kind,
             num_class=getattr(objective, "num_class", 1),
@@ -511,6 +554,13 @@ def train_booster(
             avg_output=rf_mode,
             objective_params=_objective_params(objective),
         )
+        if _capture_resume_state:
+            booster._resume_capture = {
+                "raw": np.asarray(raw)[:n_orig],
+                "rng_state": rng.bit_generator.state,
+                "frng_state": frng.bit_generator.state,
+            }
+        return booster
 
     round_hist = obs_registry().histogram(
         "gbdt_round_seconds",
@@ -641,7 +691,7 @@ def train_booster(
         else t
         for t in trees
     ]
-    return Booster(
+    booster = Booster(
         trees,
         objective.kind,
         num_class=getattr(objective, "num_class", 1),
@@ -651,6 +701,159 @@ def train_booster(
         avg_output=rf_mode,
         objective_params=_objective_params(objective),
     )
+    if _capture_resume_state:
+        booster._resume_capture = {
+            "raw": np.asarray(raw)[:n_orig],
+            "rng_state": rng.bit_generator.state,
+            "frng_state": frng.bit_generator.state,
+        }
+    return booster
+
+
+def _gbdt_fingerprint(x: np.ndarray, y: np.ndarray, objective: Objective,
+                      cfg: TrainConfig,
+                      sample_weight: Optional[np.ndarray],
+                      valid_mask: Optional[np.ndarray]) -> str:
+    """Identity of (config, data, weights, validation split, objective) a
+    GBDT checkpoint may resume against. Data is sampled (64 rows) — cheap
+    at 100M rows, still collision-proof against "resumed on the wrong
+    shard" mistakes; weights and the valid split are part of the identity
+    because resuming under different ones would mix ensembles silently."""
+    import hashlib
+    import json
+
+    ident = dataclasses.asdict(cfg)
+    ident["categorical_indexes"] = list(ident["categorical_indexes"])
+    ident["objective"] = objective.kind
+    ident["num_class"] = getattr(objective, "num_class", 1)
+    ident["n"] = int(x.shape[0])
+    ident["f"] = int(x.shape[1])
+    ident["has_weight"] = sample_weight is not None
+    ident["has_valid"] = valid_mask is not None
+    h = hashlib.sha256(json.dumps(ident, sort_keys=True).encode())
+    idx = np.linspace(0, x.shape[0] - 1, min(64, x.shape[0])).astype(int)
+    h.update(np.ascontiguousarray(np.asarray(x, np.float64)[idx]).tobytes())
+    h.update(np.ascontiguousarray(np.asarray(y, np.float64)[idx]).tobytes())
+    if sample_weight is not None:
+        h.update(np.ascontiguousarray(
+            np.asarray(sample_weight, np.float64)[idx]).tobytes())
+    if valid_mask is not None:
+        h.update(np.ascontiguousarray(
+            np.asarray(valid_mask, bool)[idx]).tobytes())
+    return h.hexdigest()
+
+
+def _train_booster_checkpointed(
+    x: np.ndarray,
+    y: np.ndarray,
+    objective: Objective,
+    cfg: TrainConfig,
+    sample_weight: Optional[np.ndarray],
+    valid_mask: Optional[np.ndarray],
+    init_model: Optional[Booster],
+    feature_names: Optional[List[str]],
+    init_raw: Optional[np.ndarray],
+    checkpoint_dir: str,
+    checkpoint_every: int,
+    checkpoint_keep_last: int,
+) -> Booster:
+    """Boosting driven in `checkpoint_every`-iteration segments, each
+    committing to a crash-consistent CheckpointStore; a resumed fit grows
+    bit-identical trees to an uninterrupted one (the raw scores and rng
+    states cross segments exactly — this is also the seed of incremental
+    GBDT refresh: warm-start boosting on the committed ensemble state).
+    """
+    import json
+
+    from mmlspark_tpu.io.checkpoint import CheckpointStore, pack_arrays
+
+    if cfg.boosting_type == "rf":
+        raise ValueError(
+            "checkpoint_dir supports boosting (gbdt/dart/goss), not rf: "
+            "random-forest trees are independent bagged fits whose "
+            "continuation semantics differ — refit instead"
+        )
+    if cfg.early_stopping_round > 0:
+        raise ValueError(
+            "checkpoint_dir and early_stopping_round are mutually "
+            "exclusive: the stopping tracker's state does not span "
+            "checkpoint segments; disable one of them"
+        )
+    if checkpoint_every < 1:
+        raise ValueError("checkpoint_every must be >= 1")
+
+    log = get_logger("mmlspark_tpu.gbdt")
+    store = CheckpointStore(checkpoint_dir, keep_last=checkpoint_keep_last)
+    fingerprint = _gbdt_fingerprint(x, y, objective, cfg, sample_weight,
+                                    valid_mask)
+
+    booster = init_model
+    resume: Optional[Dict[str, Any]] = None
+    done = 0
+    ck = store.load_latest()
+    if ck is not None:
+        if ck.meta.get("fingerprint") != fingerprint:
+            raise ValueError(
+                f"checkpoint store {checkpoint_dir!r} was written by a "
+                "different GBDT/data configuration (fingerprint mismatch). "
+                "Pass a fresh checkpoint_dir, delete the stale store, or "
+                "restore the original configuration to resume it."
+            )
+        booster = Booster.from_string(ck.text("model.txt"))
+        state = ck.json("state.json")
+        resume = {
+            "raw": ck.arrays("raw.npz")["raw"],
+            "rng_state": state["rng_state"],
+            "frng_state": state["frng_state"],
+        }
+        done = int(ck.meta["iters_done"])
+        log.info(
+            "resuming boosting from checkpoint generation %d "
+            "(%d/%d iterations done)",
+            ck.generation, done, cfg.num_iterations,
+        )
+
+    while done < cfg.num_iterations:
+        seg = min(checkpoint_every, cfg.num_iterations - done)
+        seg_cfg = dataclasses.replace(cfg, num_iterations=seg)
+        booster = train_booster(
+            x, y, objective, seg_cfg,
+            sample_weight=sample_weight, valid_mask=valid_mask,
+            init_model=booster, feature_names=feature_names,
+            # per-row base margins fold into `raw` in the first segment and
+            # ride the checkpointed raw from then on
+            init_raw=init_raw if (done == 0 and resume is None) else None,
+            _resume_state=resume,
+            _capture_resume_state=True,
+        )
+        done += seg
+        resume = booster._resume_capture
+        store.save(
+            {
+                "model.txt": booster.model_to_string().encode("utf-8"),
+                "raw.npz": pack_arrays({"raw": resume["raw"]}),
+                "state.json": json.dumps({
+                    "rng_state": resume["rng_state"],
+                    "frng_state": resume["frng_state"],
+                }).encode("utf-8"),
+            },
+            meta={"iters_done": done, "fingerprint": fingerprint},
+        )
+
+    if booster is None:  # num_iterations <= 0 and nothing to resume
+        return train_booster(
+            x, y, objective, cfg,
+            sample_weight=sample_weight, valid_mask=valid_mask,
+            init_model=init_model, feature_names=feature_names,
+            init_raw=init_raw,
+        )
+    # the capture exists only to cross segment boundaries: returning it
+    # would pin a per-row float32 raw array for the model's lifetime
+    if hasattr(booster, "_resume_capture"):
+        del booster._resume_capture
+    # a fully-resumed fit (done >= target at load) returns the committed
+    # ensemble as-is
+    return booster
 
 
 def _objective_params(obj: Objective) -> Dict[str, Any]:

@@ -8,6 +8,28 @@ DataFrames.
 """
 
 from mmlspark_tpu.io.binary import read_binary
+from mmlspark_tpu.io.checkpoint import (
+    Checkpoint,
+    CheckpointStore,
+    CorruptArtifactError,
+    atomic_write_bytes,
+    atomic_write_text,
+    fsync_tree,
+    publish_dir,
+)
 from mmlspark_tpu.io.image import read_images
+from mmlspark_tpu.io.storage_faults import InjectedCrash, StorageFaultInjector
 
-__all__ = ["read_binary", "read_images"]
+__all__ = [
+    "read_binary",
+    "read_images",
+    "Checkpoint",
+    "CheckpointStore",
+    "CorruptArtifactError",
+    "InjectedCrash",
+    "StorageFaultInjector",
+    "atomic_write_bytes",
+    "atomic_write_text",
+    "fsync_tree",
+    "publish_dir",
+]

@@ -1,0 +1,519 @@
+"""Crash-consistent artifact store: atomic writes, verified loads, retention.
+
+TPU pods get preempted. The reference framework survives a lost worker
+because Spark re-runs its tasks; our trainers hold all progress in process
+memory, so a `kill -9` at epoch 9 of 10 used to lose everything. This module
+is the durability layer under `TPULearner.fit(checkpoint_dir=...)` and the
+GBDT trainer's per-K-rounds checkpoints (docs/persistence.md), and the home
+of the atomic-write helpers every persisting class routes through
+(Network/NetworkBundle/Booster/save_stage — the `non-atomic-artifact-write`
+graftcheck rule keeps it that way).
+
+Commit protocol (`CheckpointStore.save`), in order — each step's failure
+mode leaves the store loadable:
+
+1. create a unique tmp dir *inside the store root* (same filesystem, so the
+   final rename is atomic; readers never look inside ``.tmp-*``);
+2. write every payload file into it, ``fsync`` each one (data durable
+   before the commit record exists);
+3. write ``MANIFEST.json`` LAST — per-file SHA-256 + byte sizes + the
+   generation number. The manifest IS the commit record: a generation
+   directory without a valid manifest is garbage by definition;
+4. ``fsync`` the tmp dir (entries durable), then ``os.replace`` it to
+   ``gen_<NNNNNNNN>`` — the atomic publish — and ``fsync`` the store root
+   (the rename itself durable across power loss).
+
+A crash before step 4 leaves only an invisible tmp dir (GC'd by the next
+writer); a crash during the rename leaves either the tmp name or the final
+name, never a half state (POSIX rename atomicity). Torn files can therefore
+only be observed in a generation whose manifest *also* landed — impossible
+under the ordering above on a correctly-fsyncing filesystem, and still
+*detected* (bad hash / short file) and quarantined on a lying one.
+
+Verified load (`load_latest`) walks generations newest-first, re-hashes
+every file against the manifest and returns the first intact one; corrupt
+generations (bad hash, missing/truncated manifest, torn or missing file)
+are moved to ``quarantine/`` — never deleted, they are forensic evidence —
+and the walk falls back to the previous generation, incrementing
+``checkpoint_resume_total{outcome="fallback"}``.
+
+Fault injection: every filesystem touch routes through the module-level
+`_fs` ops, which consult the store's `fault_injector` (or the globally
+installed one — `io/storage_faults.py`); the injector raises the same
+OSError types a real disk produces, or `InjectedCrash` to simulate a kill
+at an exact byte/step. bench.run_recovery_smoke and
+tests/test_checkpoint.py sweep every such fault point.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import io as _io
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.config import get_logger
+
+log = get_logger("mmlspark_tpu.io.checkpoint")
+
+MANIFEST = "MANIFEST.json"
+_GEN_PREFIX = "gen_"
+_TMP_PREFIX = ".tmp-"
+_QUARANTINE = "quarantine"
+
+#: process-global fault injector (storage_faults.installed() context manager);
+#: a store-level `fault_injector=` takes precedence.
+_GLOBAL_INJECTOR: Optional[Any] = None
+
+
+def set_global_fault_injector(inj: Optional[Any]) -> None:
+    global _GLOBAL_INJECTOR
+    _GLOBAL_INJECTOR = inj
+
+
+class CorruptArtifactError(RuntimeError):
+    """A persisted artifact failed verification (bad hash, missing or
+    truncated commit record, torn file). Carries the path and what to do
+    about it, so the operator never has to reverse-engineer the layout."""
+
+    def __init__(self, path: str, reason: str, recovery: str):
+        self.path = path
+        self.reason = reason
+        self.recovery = recovery
+        super().__init__(
+            f"corrupt or incomplete artifact at {path!r}: {reason}. {recovery}"
+        )
+
+
+# -- fault-injectable filesystem primitives -----------------------------------
+#
+# Every write/fsync/rename in this module (and in the persistence call sites
+# that route through the atomic helpers below) goes through these, so
+# StorageFaultInjector can tear, crash or ENOSPC any exact step. `tmp_path`
+# parameter names are a contract: these primitives are only ever handed
+# not-yet-published paths — publishing is `replace_path`'s job.
+
+
+def _injector(explicit: Optional[Any]) -> Optional[Any]:
+    return explicit if explicit is not None else _GLOBAL_INJECTOR
+
+
+def write_bytes(tmp_path: str, data: bytes, fault_injector: Optional[Any] = None) -> None:
+    """Write + flush + fsync `data` at `tmp_path` (a not-yet-published path)."""
+    inj = _injector(fault_injector)
+    if inj is not None:
+        inj.on_write(tmp_path, data)  # may tear/ENOSPC/crash
+    with open(tmp_path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    if inj is not None:
+        inj.on_fsync(tmp_path)
+
+
+def fsync_file(path: str, fault_injector: Optional[Any] = None) -> None:
+    inj = _injector(fault_injector)
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    if inj is not None:
+        inj.on_fsync(path)
+
+
+def fsync_dir(path: str, fault_injector: Optional[Any] = None) -> None:
+    """fsync a directory: makes its entries (created/renamed children)
+    durable. A no-op errno on platforms that refuse O_RDONLY dir fsync is
+    tolerated — the replace stays atomic, only power-loss durability of the
+    entry is platform-dependent there."""
+    inj = _injector(fault_injector)
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # EINVAL/EBADF on exotic filesystems
+        pass
+    finally:
+        os.close(fd)
+    if inj is not None:
+        inj.on_fsync(path)
+
+
+def fsync_tree(root: str, fault_injector: Optional[Any] = None) -> None:
+    """fsync every file and directory under `root` (bottom-up), then `root`
+    itself — the durability pass save_stage runs on its staged tmp dir
+    before publishing."""
+    for dirpath, _dirnames, filenames in os.walk(root, topdown=False):
+        for name in filenames:
+            fsync_file(os.path.join(dirpath, name), fault_injector)
+        fsync_dir(dirpath, fault_injector)
+
+
+def replace_path(src: str, dst: str, fault_injector: Optional[Any] = None) -> None:
+    """The atomic publish: `os.replace` + fsync of the parent directory."""
+    inj = _injector(fault_injector)
+    if inj is not None:
+        inj.on_replace(src, dst, os.replace)
+    else:
+        os.replace(src, dst)
+    fsync_dir(os.path.dirname(os.path.abspath(dst)) or ".", fault_injector)
+
+
+def atomic_write_bytes(path: str, data: bytes, fault_injector: Optional[Any] = None) -> None:
+    """Crash-consistent single-file write: unique tmp sibling, fsync,
+    rename over `path`, fsync parent. A crash at any step leaves either the
+    old file or the new one, never a torn hybrid."""
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + _TMP_PREFIX, dir=parent
+    )
+    os.close(fd)
+    try:
+        write_bytes(tmp, data, fault_injector)
+        replace_path(tmp, path, fault_injector)
+    except Exception:
+        # a live failure (ENOSPC, permission) cleans its scratch; an
+        # InjectedCrash (BaseException) deliberately leaves it, like a kill
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_text(path: str, text: str, fault_injector: Optional[Any] = None) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"), fault_injector)
+
+
+def publish_dir(tmp_dir: str, dst: str, fault_injector: Optional[Any] = None) -> None:
+    """Publish a fully-written staging directory at `dst`: fsync the tree,
+    then atomically swap it in. When `dst` already exists it is parked at a
+    unique trash name first (os.replace cannot replace a non-empty dir);
+    a live failure swaps the old version back. The park-then-swap window is
+    the one residual non-atomicity for *replacing* directory artifacts — the
+    checkpoint store never hits it (generation dirs are never overwritten).
+    """
+    import glob as _glob
+
+    fsync_tree(tmp_dir, fault_injector)
+    parent = os.path.dirname(os.path.abspath(dst)) or "."
+    trash = None
+    if os.path.exists(dst):
+        # at most ONE parked incumbent per dst: trash left by an earlier
+        # kill holds a version dst has since superseded — reclaim it now so
+        # crash-window recovery is never ambiguous about which park is
+        # current (dst escaped: its own characters must not glob)
+        for stale in _glob.glob(_glob.escape(dst) + ".trash-*"):
+            shutil.rmtree(stale, ignore_errors=True)
+        trash = tempfile.mkdtemp(
+            prefix=os.path.basename(dst) + ".trash-", dir=parent
+        )
+        os.rmdir(trash)  # need the unique NAME; replace recreates it
+        os.replace(dst, trash)
+    try:
+        replace_path(tmp_dir, dst, fault_injector)
+    except Exception:
+        # live failure: swap the parked incumbent back. A simulated kill
+        # (InjectedCrash, a BaseException) skips this on purpose — a dead
+        # process restores nothing; the incumbent survives at the trash
+        # name, recoverable by hand, never silently deleted.
+        if trash is not None and not os.path.exists(dst):
+            try:
+                os.replace(trash, dst)
+                trash = None
+            except OSError:
+                pass
+        raise
+    if trash is not None:
+        shutil.rmtree(trash, ignore_errors=True)
+
+
+@contextlib.contextmanager
+def staged_dir(dst: str, fault_injector: Optional[Any] = None) -> Iterator[str]:
+    """The directory-artifact staging protocol as one reusable block: yields
+    a fresh tmp sibling of `dst` to build into; a clean exit fsyncs the tree
+    and publishes it atomically at `dst` (publish_dir); a live failure
+    reclaims the staging dir and re-raises. A simulated kill (InjectedCrash,
+    a BaseException) leaves the staging dir behind — like a real one.
+    Used by save_stage/save_dataframe/Network.save_to_dir so the protocol
+    lives in exactly one place."""
+    parent = os.path.dirname(os.path.abspath(dst)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp_dir = tempfile.mkdtemp(
+        prefix=os.path.basename(dst) + _TMP_PREFIX, dir=parent
+    )
+    try:
+        yield tmp_dir
+        publish_dir(tmp_dir, dst, fault_injector)
+    except Exception:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        raise
+
+
+# -- array <-> bytes helpers ---------------------------------------------------
+
+
+def pack_arrays(arrays: Dict[str, np.ndarray]) -> bytes:
+    """Serialize a flat {name: ndarray} dict to npz bytes (allow_pickle off:
+    checkpoints must never gain pickle semantics)."""
+    buf = _io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+    return buf.getvalue()
+
+
+def unpack_arrays(data: bytes) -> Dict[str, np.ndarray]:
+    with np.load(_io.BytesIO(data), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+# -- the store -----------------------------------------------------------------
+
+
+class Checkpoint:
+    """One verified generation: its number, raw file bytes, and user meta."""
+
+    __slots__ = ("generation", "files", "meta", "path")
+
+    def __init__(self, generation: int, files: Dict[str, bytes],
+                 meta: Dict[str, Any], path: str):
+        self.generation = generation
+        self.files = files
+        self.meta = meta
+        self.path = path
+
+    def arrays(self, name: str) -> Dict[str, np.ndarray]:
+        return unpack_arrays(self.files[name])
+
+    def json(self, name: str) -> Any:
+        return json.loads(self.files[name].decode("utf-8"))
+
+    def text(self, name: str) -> str:
+        return self.files[name].decode("utf-8")
+
+
+def _obs():
+    """(write histogram, bytes counter, resume counter, generation gauge) —
+    resolved per call so registry resets in tests pick up fresh families."""
+    from mmlspark_tpu.obs.metrics import registry
+
+    reg = registry()
+    return (
+        reg.histogram("checkpoint_write_seconds",
+                      "Wall seconds per checkpoint commit"),
+        reg.counter("checkpoint_bytes_total",
+                    "Payload bytes committed to checkpoint stores"),
+        reg.counter("checkpoint_resume_total",
+                    "Checkpoint load outcomes", ("outcome",)),
+        reg.gauge("checkpoint_generation",
+                  "Latest committed checkpoint generation"),
+    )
+
+
+class CheckpointStore:
+    """Crash-consistent, integrity-verified generation store at `root`.
+
+    Not a concurrent-writer store: one training process owns a store at a
+    time (generation numbers are scanned, not locked). Readers are always
+    safe — they only ever see committed generations.
+    """
+
+    def __init__(self, root: str, keep_last: int = 3,
+                 fault_injector: Optional[Any] = None):
+        if keep_last < 1:
+            raise ValueError("keep_last must be >= 1")
+        self.root = os.path.abspath(root)
+        self.keep_last = int(keep_last)
+        self.fault_injector = fault_injector
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- enumeration -----------------------------------------------------------
+
+    def generations(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith(_GEN_PREFIX):
+                try:
+                    out.append(int(name[len(_GEN_PREFIX):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_generation(self) -> Optional[int]:
+        gens = self.generations()
+        return gens[-1] if gens else None
+
+    def _gen_dir(self, generation: int) -> str:
+        return os.path.join(self.root, f"{_GEN_PREFIX}{generation:08d}")
+
+    # -- commit ----------------------------------------------------------------
+
+    def save(self, files: Dict[str, bytes],
+             meta: Optional[Dict[str, Any]] = None) -> int:
+        """Commit `files` as the next generation; returns its number.
+
+        File names are flat (no path separators — the manifest maps names,
+        not trees). Raises OSError (e.g. ENOSPC) on live write failures,
+        leaving previous generations untouched.
+        """
+        from mmlspark_tpu.obs import tracer
+
+        for name in files:
+            if os.sep in name or name in (MANIFEST, ""):
+                raise ValueError(f"invalid checkpoint file name {name!r}")
+        write_hist, bytes_total, _resume, gen_gauge = _obs()
+        t0 = time.perf_counter()
+        gen = (self.latest_generation() or 0) + 1
+        self._gc_tmp()
+        tmp = tempfile.mkdtemp(prefix=_TMP_PREFIX, dir=self.root)
+        total = 0
+        with tracer().span("checkpoint:commit", generation=gen,
+                           n_files=len(files)):
+            try:
+                manifest: Dict[str, Any] = {
+                    "generation": gen,
+                    "files": {},
+                    "meta": meta or {},
+                    "created_unix": time.time(),
+                }
+                for name, data in sorted(files.items()):
+                    write_bytes(os.path.join(tmp, name), data,
+                                self.fault_injector)
+                    manifest["files"][name] = {
+                        "sha256": hashlib.sha256(data).hexdigest(),
+                        "bytes": len(data),
+                    }
+                    total += len(data)
+                # the commit record goes LAST: its presence asserts every
+                # payload byte above is already durable
+                write_bytes(
+                    os.path.join(tmp, MANIFEST),
+                    json.dumps(manifest, indent=1, sort_keys=True).encode(),
+                    self.fault_injector,
+                )
+                fsync_dir(tmp, self.fault_injector)
+                replace_path(tmp, self._gen_dir(gen), self.fault_injector)
+            except Exception:
+                # live failure (not a simulated kill): reclaim the scratch
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise
+        write_hist.observe(time.perf_counter() - t0)
+        bytes_total.inc(total)
+        gen_gauge.set(gen)
+        self._retain()
+        log.debug("checkpoint gen %d committed (%d files, %d bytes) at %s",
+                  gen, len(files), total, self.root)
+        return gen
+
+    def _gc_tmp(self) -> None:
+        """Reclaim tmp dirs left by crashed writers (invisible to readers)."""
+        for name in os.listdir(self.root):
+            if name.startswith(_TMP_PREFIX):
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+
+    def _retain(self) -> None:
+        gens = self.generations()
+        for gen in gens[: max(0, len(gens) - self.keep_last)]:
+            shutil.rmtree(self._gen_dir(gen), ignore_errors=True)
+
+    # -- verified load ---------------------------------------------------------
+
+    def _verify_gen(self, generation: int) -> Checkpoint:
+        """Read + verify one generation; raises CorruptArtifactError with
+        the precise reason on any integrity failure."""
+        path = self._gen_dir(generation)
+        recovery = (
+            "The store will fall back to the previous intact generation; "
+            "the corrupt one is moved to quarantine/ for inspection."
+        )
+        mpath = os.path.join(path, MANIFEST)
+        try:
+            with open(mpath, "rb") as f:
+                manifest = json.loads(f.read().decode("utf-8"))
+        except FileNotFoundError:
+            raise CorruptArtifactError(path, "missing MANIFEST.json commit "
+                                       "record", recovery)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            raise CorruptArtifactError(path, "truncated or garbled "
+                                       "MANIFEST.json", recovery)
+        if not isinstance(manifest, dict) or "files" not in manifest:
+            raise CorruptArtifactError(path, "MANIFEST.json lacks a files "
+                                       "map", recovery)
+        files: Dict[str, bytes] = {}
+        for name, rec in manifest["files"].items():
+            fpath = os.path.join(path, name)
+            try:
+                with open(fpath, "rb") as f:
+                    data = f.read()
+            except FileNotFoundError:
+                raise CorruptArtifactError(
+                    path, f"payload file {name!r} missing", recovery)
+            if len(data) != rec.get("bytes"):
+                raise CorruptArtifactError(
+                    path,
+                    f"payload file {name!r} is {len(data)} bytes, manifest "
+                    f"says {rec.get('bytes')} (torn write)", recovery)
+            digest = hashlib.sha256(data).hexdigest()
+            if digest != rec.get("sha256"):
+                raise CorruptArtifactError(
+                    path, f"payload file {name!r} hash mismatch (bit rot or "
+                    "tampering)", recovery)
+            files[name] = data
+        return Checkpoint(generation, files, manifest.get("meta", {}), path)
+
+    def _quarantine(self, generation: int, reason: str) -> None:
+        qdir = os.path.join(self.root, _QUARANTINE)
+        os.makedirs(qdir, exist_ok=True)
+        src = self._gen_dir(generation)
+        slug = "".join(c if c.isalnum() else "-" for c in reason)[:48]
+        dst = os.path.join(qdir, f"{_GEN_PREFIX}{generation:08d}.{slug}")
+        try:
+            if os.path.exists(dst):
+                shutil.rmtree(dst, ignore_errors=True)
+            os.replace(src, dst)
+        except OSError:  # quarantine is best-effort; the skip is what matters
+            log.warning("could not quarantine %s", src)
+
+    def load_latest(self) -> Optional[Checkpoint]:
+        """Newest intact generation, or None when the store holds none.
+
+        Never returns a corrupt artifact: generations failing verification
+        are quarantined and the walk falls back
+        (`checkpoint_resume_total{outcome="fallback"}`).
+        """
+        from mmlspark_tpu.obs import tracer
+
+        _w, _b, resume_total, gen_gauge = _obs()
+        fell_back = False
+        with tracer().span("checkpoint:load", root=self.root) as span:
+            for gen in reversed(self.generations()):
+                try:
+                    ck = self._verify_gen(gen)
+                except CorruptArtifactError as e:
+                    log.warning("checkpoint gen %d failed verification: %s",
+                                gen, e.reason)
+                    self._quarantine(gen, e.reason.split("(")[0].strip())
+                    fell_back = True
+                    continue
+                outcome = "fallback" if fell_back else "resumed"
+                resume_total.labels(outcome=outcome).inc()
+                gen_gauge.set(gen)
+                span.set_attribute("generation", gen)
+                span.set_attribute("outcome", outcome)
+                return ck
+            resume_total.labels(
+                outcome="fallback" if fell_back else "fresh"
+            ).inc()
+            span.set_attribute("outcome", "fresh")
+        return None

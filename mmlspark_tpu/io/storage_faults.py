@@ -1,0 +1,218 @@
+"""Storage fault injection for the checkpoint/persistence layer.
+
+The disk-side sibling of `serving/faults.py`: deterministic faults injected
+at the exact filesystem steps `io/checkpoint.py` routes every persistence
+write through (write / fsync / rename), raising the same exception types a
+real disk produces — `OSError(ENOSPC)` for a full disk, torn files for a
+power cut mid-write — plus `InjectedCrash` to simulate the process dying at
+a precise point (`kill -9` semantics: nothing after the fault runs, no
+cleanup handlers fire).
+
+`InjectedCrash` deliberately subclasses BaseException: product code that
+catches `Exception` for cleanup must NOT intercept a simulated kill, or the
+harness would test a politely-failing process instead of a dead one.
+
+Faults are armed per-operation with an optional path substring match and a
+1-based `nth` occurrence, so a crash-point sweep can kill a training fit at
+*every* checkpoint boundary in turn (tests/test_checkpoint.py,
+bench.run_recovery_smoke). `record_ops=True` first runs a fit while logging
+every (op, path) touch; the sweep then replays with `crash_at_op(i)` for
+each i — interrupting at every injected fault point without knowing the
+store's internals.
+
+Install either per-store (`CheckpointStore(fault_injector=...)`) or
+process-wide for code paths that build their own stores
+(`with installed(inj): learner.fit(df)`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from mmlspark_tpu.core.config import get_logger
+
+log = get_logger("mmlspark_tpu.io.checkpoint")
+
+
+class InjectedCrash(BaseException):
+    """Simulated process death at an injected fault point. BaseException on
+    purpose — see module docstring. Only the test/bench harness catches it."""
+
+
+class StorageFaultInjector:
+    """Deterministic storage fault state consulted by `io/checkpoint._fs`
+    primitives. Thread-safe; each armed fault fires once (at its `nth`
+    matching operation) unless documented persistent."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._faults: List[Dict[str, Any]] = []
+        self._op_counter = 0
+        self.record_ops = False
+        self.ops: List[Tuple[str, str]] = []
+
+    # -- arming ----------------------------------------------------------------
+
+    def _arm(self, **fault: Any) -> None:
+        fault["seen"] = 0  # per-fault occurrence count: armed faults never
+        # share counters, so rearming or stacking faults on one op is exact
+        with self._lock:
+            self._faults.append(fault)
+
+    def torn_write(self, match: str = "", at_byte: int = 0, nth: int = 1) -> None:
+        """The nth matching write lands only its first `at_byte` bytes on
+        disk, then the process dies (power cut mid-write)."""
+        self._arm(kind="torn", op="write", match=match, nth=nth,
+                  at_byte=int(at_byte))
+
+    def crash_on_write(self, match: str = "", nth: int = 1) -> None:
+        """Die just before the nth matching write (file never created)."""
+        self._arm(kind="crash", op="write", match=match, nth=nth)
+
+    def crash_on_fsync(self, match: str = "", nth: int = 1) -> None:
+        """Die at the nth matching fsync — the written bytes may or may not
+        be durable; the commit record that would follow never lands."""
+        self._arm(kind="crash", op="fsync", match=match, nth=nth)
+
+    def crash_before_rename(self, match: str = "", nth: int = 1) -> None:
+        """Die just before the nth matching atomic publish: the staged tmp
+        dir/file exists, the final name was never created/updated."""
+        self._arm(kind="crash_before", op="replace", match=match, nth=nth)
+
+    def crash_after_rename(self, match: str = "", nth: int = 1) -> None:
+        """Die just after the nth matching atomic publish: the new
+        generation is fully committed, nothing after it ran (retention,
+        in-memory bookkeeping, the rest of training)."""
+        self._arm(kind="crash_after", op="replace", match=match, nth=nth)
+
+    def enospc(self, match: str = "", nth: int = 1) -> None:
+        """The nth matching write raises OSError(ENOSPC) after landing a
+        prefix of the data (how a full disk actually fails)."""
+        self._arm(kind="enospc", op="write", match=match, nth=nth)
+
+    def slow_fsync(self, delay_s: float) -> None:
+        """Every fsync takes `delay_s` (a saturated device). Persistent."""
+        self._arm(kind="slow", op="fsync", match="", nth=0,
+                  delay_s=float(delay_s))
+
+    def crash_at_op(self, op_index: int) -> None:
+        """Die at the op_index-th (0-based) filesystem operation of any
+        kind — paired with `record_ops` this sweeps every fault point."""
+        self._arm(kind="crash_at_op", op="*", nth=0, match="",
+                  op_index=int(op_index))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._faults.clear()
+            self._op_counter = 0
+
+    # -- direct corruption (post-commit, no hook needed) -----------------------
+
+    @staticmethod
+    def bit_flip(path: str, byte_index: Optional[int] = None,
+                 bit: int = 0) -> None:
+        """Flip one bit of a committed file in place — silent media
+        corruption that only integrity verification can catch."""
+        with open(path, "r+b") as f:  # in-place corruption, not an artifact write  # graftcheck: ignore[non-atomic-artifact-write]
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if size == 0:
+                return
+            idx = size // 2 if byte_index is None else min(byte_index, size - 1)
+            f.seek(idx)
+            b = f.read(1)
+            f.seek(idx)
+            f.write(bytes([b[0] ^ (1 << (bit % 8))]))
+
+    @staticmethod
+    def truncate(path: str, keep_bytes: int) -> None:
+        """Truncate a committed file — a torn write observed post-hoc."""
+        with open(path, "r+b") as f:  # in-place corruption, not an artifact write  # graftcheck: ignore[non-atomic-artifact-write]
+            f.truncate(keep_bytes)
+
+    # -- hooks (called by io/checkpoint primitives) ----------------------------
+
+    def _next(self, op: str, path: str) -> Optional[Dict[str, Any]]:
+        """Find-and-consume the fault due at this (op, path), if any."""
+        with self._lock:
+            self._op_counter += 1
+            if self.record_ops:
+                self.ops.append((op, path))
+            for fault in list(self._faults):
+                if fault["kind"] == "crash_at_op":
+                    if self._op_counter - 1 == fault["op_index"]:
+                        self._faults.remove(fault)
+                        return fault
+                    continue
+                if fault["op"] != op or fault["match"] not in path:
+                    continue
+                if fault["kind"] == "slow":
+                    return fault  # persistent, never consumed
+                fault["seen"] += 1
+                if fault["seen"] == fault["nth"]:
+                    self._faults.remove(fault)
+                    return fault
+            return None
+
+    def on_write(self, path: str, data: bytes) -> None:
+        fault = self._next("write", path)
+        if fault is None:
+            return
+        kind = fault["kind"]
+        if kind == "crash_at_op" or kind == "crash":
+            log.info("fault: crash before write of %s", path)
+            raise InjectedCrash(f"crash before write {path}")
+        if kind == "torn":
+            with open(path, "wb") as f:  # deliberately torn: the fault under test  # graftcheck: ignore[non-atomic-artifact-write]
+                f.write(data[: fault["at_byte"]])
+                f.flush()
+                os.fsync(f.fileno())
+            log.info("fault: torn write of %s at byte %d", path,
+                     fault["at_byte"])
+            raise InjectedCrash(f"torn write {path}@{fault['at_byte']}")
+        if kind == "enospc":
+            with open(path, "wb") as f:  # deliberately partial: ENOSPC under test  # graftcheck: ignore[non-atomic-artifact-write]
+                f.write(data[: max(0, len(data) // 2)])
+            raise OSError(errno.ENOSPC, os.strerror(errno.ENOSPC), path)
+
+    def on_fsync(self, path: str) -> None:
+        fault = self._next("fsync", path)
+        if fault is None:
+            return
+        if fault["kind"] == "slow":
+            time.sleep(fault["delay_s"])
+            return
+        log.info("fault: crash at fsync of %s", path)
+        raise InjectedCrash(f"crash at fsync {path}")
+
+    def on_replace(self, src: str, dst: str,
+                   do_replace: Callable[[str, str], None]) -> None:
+        fault = self._next("replace", dst)
+        if fault is None:
+            do_replace(src, dst)
+            return
+        kind = fault["kind"]
+        if kind in ("crash_before", "crash_at_op", "crash"):
+            log.info("fault: crash BEFORE rename %s -> %s", src, dst)
+            raise InjectedCrash(f"crash before rename {dst}")
+        do_replace(src, dst)
+        log.info("fault: crash AFTER rename %s -> %s", src, dst)
+        raise InjectedCrash(f"crash after rename {dst}")
+
+
+@contextlib.contextmanager
+def installed(inj: StorageFaultInjector) -> Iterator[StorageFaultInjector]:
+    """Install `inj` process-wide for code that builds its own stores
+    (`TPULearner.fit`, the GBDT trainer); always uninstalled on exit."""
+    from mmlspark_tpu.io import checkpoint as _ckpt
+
+    _ckpt.set_global_fault_injector(inj)
+    try:
+        yield inj
+    finally:
+        _ckpt.set_global_fault_injector(None)

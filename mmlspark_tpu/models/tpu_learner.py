@@ -71,6 +71,22 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
         "Device mesh as [dp] or [dp, tp]; default all devices on the data axis",
         TypeConverters.to_list_int,
     )
+    checkpoint_dir = Param(
+        "checkpoint_dir",
+        "Crash-consistent checkpoint store directory; fit() snapshots train "
+        "state there and resumes from the last good generation (unset: off)",
+        TypeConverters.to_string,
+    )
+    checkpoint_every = Param(
+        "checkpoint_every",
+        "Commit a checkpoint every N epochs (the final epoch always commits)",
+        TypeConverters.to_int,
+    )
+    checkpoint_keep_last = Param(
+        "checkpoint_keep_last",
+        "Checkpoint generations retained per store (older ones are deleted)",
+        TypeConverters.to_int,
+    )
 
     def __init__(self, network: Optional[Network] = None, **kwargs: Any):
         super().__init__()
@@ -87,6 +103,8 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
             seed=0,
             shuffle=True,
             output_col="scores",
+            checkpoint_every=1,
+            checkpoint_keep_last=3,
         )
         if network is not None:
             self.set(self.network, network)
@@ -194,9 +212,66 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
             y = np.rint(yv.astype(np.float64)).astype(np.int32)
         return x, y
 
+    # -- checkpoint/resume -----------------------------------------------------
+
+    def _fit_fingerprint(self, x: np.ndarray, y: np.ndarray) -> str:
+        """Identity of (config, data) a checkpoint may resume against —
+        resuming with a different network/optimizer/data would silently
+        train a chimera, so the store refuses it loudly instead."""
+        import hashlib
+        import json
+
+        net: Network = self.get(self.network)
+        ident = {
+            "spec": net.spec,
+            "input_shape": list(net.input_shape),
+            "loss": self.get(self.loss),
+            "optimizer": self.get(self.optimizer),
+            "learning_rate": self.get(self.learning_rate),
+            "momentum": self.get(self.momentum),
+            "weight_decay": self.get(self.weight_decay),
+            "batch_size": self.get(self.batch_size),
+            "seed": self.get(self.seed),
+            "shuffle": self.get(self.shuffle),
+            "x_shape": list(x.shape),
+            "y_shape": list(y.shape),
+        }
+        h = hashlib.sha256(json.dumps(ident, sort_keys=True).encode())
+        idx = np.linspace(0, x.shape[0] - 1, min(64, x.shape[0])).astype(int)
+        h.update(np.ascontiguousarray(x[idx]).tobytes())
+        h.update(np.ascontiguousarray(y[idx]).tobytes())
+        return h.hexdigest()
+
+    def _commit_checkpoint(self, store, train_state, key, rng, epoch: int,
+                           losses: List[float], fingerprint: str) -> None:
+        """Snapshot everything fit() would need to continue as if never
+        killed: weights + optimizer + BN state (flattened tree leaves), the
+        jax PRNG key, the numpy shuffle rng state, and the epoch cursor."""
+        import jax
+        import json
+
+        from mmlspark_tpu.io.checkpoint import pack_arrays
+
+        host = jax.device_get(train_state)
+        leaves = jax.tree_util.tree_leaves(host)
+        arrays = {f"l{i:05d}": np.asarray(v) for i, v in enumerate(leaves)}
+        arrays["jax_key"] = np.asarray(key)
+        store.save(
+            {
+                "train_state.npz": pack_arrays(arrays),
+                "np_rng.json": json.dumps(rng.bit_generator.state).encode(),
+            },
+            meta={
+                "epoch": int(epoch),
+                "losses": [float(v) for v in losses],
+                "fingerprint": fingerprint,
+            },
+        )
+
     # -- fit -------------------------------------------------------------------
 
-    def fit(self, df: DataFrame) -> TPUModel:
+    def fit(self, df: DataFrame, checkpoint_dir: Optional[str] = None,
+            checkpoint_every: Optional[int] = None) -> TPUModel:
         import jax
         import jax.numpy as jnp
 
@@ -221,6 +296,51 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
             "state": variables["state"],
             "opt": opt_state,
         }
+
+        # -- resume from the last good checkpoint generation, if any ----------
+        ckpt_dir = checkpoint_dir or (
+            self.get(self.checkpoint_dir)
+            if self.is_set(self.checkpoint_dir) else None
+        )
+        every = int(checkpoint_every
+                    if checkpoint_every is not None
+                    else self.get(self.checkpoint_every))
+        store = None
+        start_epoch = 0
+        losses: List[float] = []
+        fingerprint = ""
+        if ckpt_dir:
+            import json
+
+            from mmlspark_tpu.io.checkpoint import CheckpointStore
+
+            store = CheckpointStore(
+                ckpt_dir, keep_last=self.get(self.checkpoint_keep_last)
+            )
+            fingerprint = self._fit_fingerprint(x, y)
+            ck = store.load_latest()
+            if ck is not None:
+                if ck.meta.get("fingerprint") != fingerprint:
+                    raise ValueError(
+                        f"checkpoint store {ckpt_dir!r} was written by a "
+                        "different learner/data configuration (fingerprint "
+                        "mismatch). Pass a fresh checkpoint_dir, delete the "
+                        "stale store, or restore the original configuration "
+                        "to resume it."
+                    )
+                arrays = ck.arrays("train_state.npz")
+                treedef = jax.tree_util.tree_structure(train_state)
+                leaves = [arrays[f"l{i:05d}"]
+                          for i in range(treedef.num_leaves)]
+                train_state = jax.tree_util.tree_unflatten(treedef, leaves)
+                key = jnp.asarray(arrays["jax_key"])
+                rng.bit_generator.state = json.loads(ck.text("np_rng.json"))
+                losses = [float(v) for v in ck.meta["losses"]]
+                start_epoch = int(ck.meta["epoch"]) + 1
+                log.info(
+                    "resuming fit from checkpoint generation %d at epoch %d",
+                    ck.generation, start_epoch,
+                )
 
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -259,10 +379,10 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
             jax.jit(step, donate_argnums=(0,)) if donate_ok else jax.jit(step)
         )
 
-        losses: List[float] = []
         steps_per_epoch = -(-n // bs)  # ceil: the final partial batch is
         # padded with zero-weight rows, never dropped
-        for epoch in range(self.get(self.epochs)):
+        epochs = self.get(self.epochs)
+        for epoch in range(start_epoch, epochs):
             order = rng.permutation(n) if self.get(self.shuffle) else np.arange(n)
             epoch_loss = 0.0
             count = 0
@@ -289,6 +409,12 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
                 count += len(idx)
             losses.append(epoch_loss / max(1, count))
             log.debug("epoch %d loss %.5f", epoch, losses[-1])
+            if store is not None and (
+                (epoch + 1) % max(1, every) == 0 or epoch == epochs - 1
+            ):
+                self._commit_checkpoint(
+                    store, train_state, key, rng, epoch, losses, fingerprint
+                )
 
         final = jax.device_get(
             {"params": train_state["params"], "state": train_state["state"]}

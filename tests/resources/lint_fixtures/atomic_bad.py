@@ -1,0 +1,62 @@
+"""Fixture for the non-atomic-artifact-write rule: in-place writes to
+final artifact paths inside the persistence tier. Parsed, never imported."""
+
+import json
+import os
+import tempfile
+
+
+def bad_save_json(path, payload):
+    with open(os.path.join(path, "model.json"), "w") as f:  # expect[non-atomic-artifact-write]
+        json.dump(payload, f)
+
+
+def bad_save_binary(final_path, blob):
+    f = open(final_path, "wb")  # expect[non-atomic-artifact-write]
+    f.write(blob)
+    f.close()
+
+
+def bad_append_log(artifact_path, line):
+    with open(artifact_path, "a") as f:  # expect[non-atomic-artifact-write]
+        f.write(line)
+
+
+def bad_str_replace_is_not_a_publish(path, template):
+    text = template.replace("a", "b")  # str.replace must not whitelist
+    with open(path, "w") as f:  # expect[non-atomic-artifact-write]
+        f.write(text)
+
+
+def suppressed_scratch(path, blob):
+    with open(path, "wb") as f:  # pre-commit scratch, rebuilt on load  # graftcheck: ignore[non-atomic-artifact-write]  # expect-suppressed[non-atomic-artifact-write]
+        f.write(blob)
+
+
+def clean_tmp_name_discipline(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:  # clean: writes a tmp-staged name
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def clean_publish_in_same_function(path, blob):
+    staging = path + ".staging"
+    with open(staging, "wb") as f:  # clean: os.replace publishes below
+        f.write(blob)
+    os.replace(staging, path)
+
+
+def clean_tempfile_staging(path, blob):
+    fd, scratch = tempfile.mkstemp(dir=os.path.dirname(path))
+    os.close(fd)
+    with open(scratch, "wb") as f:  # clean: tempfile-staged sibling
+        f.write(blob)
+    os.replace(scratch, path)
+
+
+def clean_reads(path):
+    with open(path) as f:  # clean: read mode
+        data = f.read()
+    with open(path, "rb") as g:  # clean: binary read
+        return data, g.read()
