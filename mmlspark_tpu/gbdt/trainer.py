@@ -713,14 +713,21 @@ def train_booster(
 def _gbdt_fingerprint(x: np.ndarray, y: np.ndarray, objective: Objective,
                       cfg: TrainConfig,
                       sample_weight: Optional[np.ndarray],
-                      valid_mask: Optional[np.ndarray]) -> str:
-    """Identity of (config, data, weights, validation split, objective) a
-    GBDT checkpoint may resume against. Data is sampled (64 rows) — cheap
-    at 100M rows, still collision-proof against "resumed on the wrong
-    shard" mistakes; weights and the valid split are part of the identity
-    because resuming under different ones would mix ensembles silently."""
+                      valid_mask: Optional[np.ndarray],
+                      init_model: Optional[Booster],
+                      init_raw: Optional[np.ndarray]) -> str:
+    """Identity of (config, data, weights, validation split, objective,
+    warm-start inputs) a GBDT checkpoint may resume against. Data is
+    sampled (64 rows) — cheap at 100M rows, still collision-proof against
+    "resumed on the wrong shard" mistakes; weights, the valid split, and
+    the warm-start ensemble/base margins are part of the identity because
+    resuming under different ones would mix ensembles silently (the
+    segment driver folds init_raw into the checkpointed raw scores and
+    replaces init_model with the committed ensemble on resume — changed
+    values would be dropped without a trace)."""
     import hashlib
-    import json
+
+    from mmlspark_tpu.io.checkpoint import fingerprint
 
     ident = dataclasses.asdict(cfg)
     ident["categorical_indexes"] = list(ident["categorical_indexes"])
@@ -730,17 +737,23 @@ def _gbdt_fingerprint(x: np.ndarray, y: np.ndarray, objective: Objective,
     ident["f"] = int(x.shape[1])
     ident["has_weight"] = sample_weight is not None
     ident["has_valid"] = valid_mask is not None
-    h = hashlib.sha256(json.dumps(ident, sort_keys=True).encode())
-    idx = np.linspace(0, x.shape[0] - 1, min(64, x.shape[0])).astype(int)
-    h.update(np.ascontiguousarray(np.asarray(x, np.float64)[idx]).tobytes())
-    h.update(np.ascontiguousarray(np.asarray(y, np.float64)[idx]).tobytes())
-    if sample_weight is not None:
-        h.update(np.ascontiguousarray(
-            np.asarray(sample_weight, np.float64)[idx]).tobytes())
-    if valid_mask is not None:
-        h.update(np.ascontiguousarray(
-            np.asarray(valid_mask, bool)[idx]).tobytes())
-    return h.hexdigest()
+    # warm-start keys enter the ident only when present: a plain fit's
+    # fingerprint stays byte-identical to stores written before these
+    # inputs were covered, so existing checkpoints keep resuming — while
+    # adding OR dropping a warm-start input still flips the hash
+    if init_raw is not None:
+        ident["has_init_raw"] = True
+    if init_model is not None:
+        ident["init_model_sha"] = hashlib.sha256(
+            init_model.model_to_string().encode()).hexdigest()
+    return fingerprint(
+        ident,
+        (x, np.float64),
+        (y, np.float64),
+        None if sample_weight is None else (sample_weight, np.float64),
+        None if valid_mask is None else (valid_mask, bool),
+        None if init_raw is None else (init_raw, np.float64),
+    )
 
 
 def _train_booster_checkpointed(
@@ -782,10 +795,18 @@ def _train_booster_checkpointed(
     if checkpoint_every < 1:
         raise ValueError("checkpoint_every must be >= 1")
 
+    # mirror the inner path's validation before fingerprinting samples
+    # init_raw with x-derived indexes (a short array would IndexError)
+    if init_raw is not None and np.asarray(init_raw).shape[0] != x.shape[0]:
+        raise ValueError(
+            f"init_score rows {np.asarray(init_raw).shape[0]} != data rows "
+            f"{x.shape[0]}"
+        )
+
     log = get_logger("mmlspark_tpu.gbdt")
     store = CheckpointStore(checkpoint_dir, keep_last=checkpoint_keep_last)
     fingerprint = _gbdt_fingerprint(x, y, objective, cfg, sample_weight,
-                                    valid_mask)
+                                    valid_mask, init_model, init_raw)
 
     booster = init_model
     resume: Optional[Dict[str, Any]] = None

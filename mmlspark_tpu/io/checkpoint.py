@@ -261,14 +261,71 @@ def staged_dir(dst: str, fault_injector: Optional[Any] = None) -> Iterator[str]:
         raise
 
 
+# -- resume identity -----------------------------------------------------------
+
+
+def fingerprint(ident: Dict[str, Any], *arrays: Any,
+                sample_rows: int = 64) -> str:
+    """Identity hash a checkpoint may resume against: sha256 of the
+    sorted-keys JSON `ident` dict, then up to `sample_rows` evenly spaced
+    rows of each array. Each entry is None (skipped — callers encode
+    *presence* in `ident` so None vs empty stays distinguishable), an
+    ndarray (hashed in its native dtype), or an ``(ndarray, dtype)`` pair
+    — the dtype normalization is applied to the sampled rows only, so
+    fingerprinting stays O(sample_rows) bytes however large the dataset
+    (no full-array copy on the resume path of a memory-tight preemptible
+    worker). Sampling keeps it cheap at 100M rows while still
+    collision-proof against "resumed on the wrong shard" mistakes. Both
+    trainers (TPULearner and the GBDT segment driver) derive their
+    fingerprints here so the resume-identity protocol cannot drift
+    between them."""
+    h = hashlib.sha256(json.dumps(ident, sort_keys=True).encode())
+    entries = [e if isinstance(e, tuple) else (e, None)
+               for e in arrays if e is not None]
+    if entries:
+        n = np.asarray(entries[0][0]).shape[0]
+        idx = np.linspace(0, n - 1, min(sample_rows, n)).astype(int)
+        for a, dt in entries:
+            a = np.asarray(a)
+            # one shared idx samples every array: a shorter companion
+            # would otherwise surface as a raw IndexError mid-hash
+            if a.shape[0] != n:
+                raise ValueError(
+                    f"fingerprint: sampled array has {a.shape[0]} rows, "
+                    f"expected {n} (all arrays must share the leading "
+                    "dimension)"
+                )
+            rows = a[idx]
+            if dt is not None:
+                rows = rows.astype(dt)
+            h.update(np.ascontiguousarray(rows).tobytes())
+    return h.hexdigest()
+
+
 # -- array <-> bytes helpers ---------------------------------------------------
 
 
 def pack_arrays(arrays: Dict[str, np.ndarray]) -> bytes:
     """Serialize a flat {name: ndarray} dict to npz bytes (allow_pickle off:
     checkpoints must never gain pickle semantics)."""
+    packed = {}
+    for k, v in arrays.items():
+        a = np.asarray(v)
+        # np.savez's write side pickles object arrays by default; the store
+        # would commit such a generation with matching hashes and then every
+        # unpack_arrays (allow_pickle=False) on it would fail — an
+        # integrity-verified checkpoint that can never be resumed. Refuse at
+        # pack time, where the caller can still fix the leaf.
+        if a.dtype.hasobject:
+            raise TypeError(
+                f"pack_arrays: array {k!r} has dtype {a.dtype} — object "
+                "arrays would be pickled into the checkpoint and can never "
+                "be unpacked (loads run with allow_pickle=False); convert "
+                "the value to a numeric, bool, or bytes dtype first"
+            )
+        packed[k] = a
     buf = _io.BytesIO()
-    np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+    np.savez(buf, **packed)
     return buf.getvalue()
 
 

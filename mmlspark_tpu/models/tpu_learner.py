@@ -218,8 +218,7 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
         """Identity of (config, data) a checkpoint may resume against —
         resuming with a different network/optimizer/data would silently
         train a chimera, so the store refuses it loudly instead."""
-        import hashlib
-        import json
+        from mmlspark_tpu.io.checkpoint import fingerprint
 
         net: Network = self.get(self.network)
         ident = {
@@ -236,11 +235,7 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
             "x_shape": list(x.shape),
             "y_shape": list(y.shape),
         }
-        h = hashlib.sha256(json.dumps(ident, sort_keys=True).encode())
-        idx = np.linspace(0, x.shape[0] - 1, min(64, x.shape[0])).astype(int)
-        h.update(np.ascontiguousarray(x[idx]).tobytes())
-        h.update(np.ascontiguousarray(y[idx]).tobytes())
-        return h.hexdigest()
+        return fingerprint(ident, x, y)
 
     def _commit_checkpoint(self, store, train_state, key, rng, epoch: int,
                            losses: List[float], fingerprint: str) -> None:
@@ -328,6 +323,22 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
                         "stale store, or restore the original configuration "
                         "to resume it."
                     )
+                start_epoch = int(ck.meta["epoch"]) + 1
+                # epochs is deliberately outside the fingerprint so raising
+                # it extends a finished run, and start_epoch == epochs is
+                # the resume-after-complete no-op; but a cursor PAST the
+                # requested horizon means the store holds more training
+                # than this fit is asking for — returning it would deliver
+                # an over-trained model with a wrong-length loss history.
+                # Checked at metadata cost, before the train state unpacks.
+                if start_epoch > self.get(self.epochs):
+                    raise ValueError(
+                        f"checkpoint store {ckpt_dir!r} holds {start_epoch} "
+                        f"completed epochs but epochs="
+                        f"{self.get(self.epochs)} was requested; raise "
+                        "epochs to extend the run or pass a fresh "
+                        "checkpoint_dir for a shorter fit"
+                    )
                 arrays = ck.arrays("train_state.npz")
                 treedef = jax.tree_util.tree_structure(train_state)
                 leaves = [arrays[f"l{i:05d}"]
@@ -336,7 +347,6 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
                 key = jnp.asarray(arrays["jax_key"])
                 rng.bit_generator.state = json.loads(ck.text("np_rng.json"))
                 losses = [float(v) for v in ck.meta["losses"]]
-                start_epoch = int(ck.meta["epoch"]) + 1
                 log.info(
                     "resuming fit from checkpoint generation %d at epoch %d",
                     ck.generation, start_epoch,

@@ -490,6 +490,35 @@ def test_learner_fingerprint_mismatch_refuses_resume(tmp_path):
         other.fit(df, checkpoint_dir=d)
 
 
+def test_learner_refuses_epochs_below_checkpoint_cursor(tmp_path):
+    """epochs stays outside the fingerprint so RAISING it extends a
+    finished run — but a cursor PAST the requested horizon must refuse, or
+    fit() would return an over-trained model with a wrong-length loss
+    history for the shorter request."""
+    from mmlspark_tpu.dnn import mlp
+    from mmlspark_tpu.models import TPULearner
+
+    df = _learner_df()
+    d = str(tmp_path / "short")
+    first = _learner().fit(df, checkpoint_dir=d, checkpoint_every=2)
+    assert len(first._loss_history) == 6
+
+    shorter = TPULearner(
+        mlp(6, [16], 2), epochs=3, batch_size=32, learning_rate=0.1, seed=7
+    )
+    with pytest.raises(ValueError, match="epochs"):
+        shorter.fit(df, checkpoint_dir=d, checkpoint_every=2)
+
+    # the documented extension path still works: a higher horizon resumes
+    # from the committed cursor and trains only the additional epochs
+    longer = TPULearner(
+        mlp(6, [16], 2), epochs=8, batch_size=32, learning_rate=0.1, seed=7
+    )
+    extended = longer.fit(df, checkpoint_dir=d, checkpoint_every=2)
+    assert len(extended._loss_history) == 8
+    assert extended._loss_history[:6] == first._loss_history
+
+
 def test_learner_resumes_through_corrupted_latest_generation(tmp_path):
     """End to end across the whole subsystem: the newest checkpoint
     generation is bit-flipped on disk; resume quarantines it, falls back a
@@ -608,6 +637,34 @@ def test_gbdt_fingerprint_mismatch_refuses_resume(tmp_path):
     _gbdt_fit(x, y, ckpt=d, num_iterations=4)
     with pytest.raises(ValueError, match="fingerprint"):
         _gbdt_fit(x, y, ckpt=d, num_iterations=4, learning_rate=0.27)
+
+
+def test_gbdt_fingerprint_covers_warm_start_inputs(tmp_path):
+    """init_raw folds into the checkpointed raw scores in segment one and
+    init_model is replaced by the committed ensemble on resume — so
+    resuming with either changed would silently drop the new value into a
+    mixed ensemble. Both are part of the resume identity."""
+    from mmlspark_tpu.gbdt.objectives import make_objective
+    from mmlspark_tpu.gbdt.trainer import TrainConfig, train_booster
+
+    x, y = _gbdt_data(n=200)
+    cfg = TrainConfig(num_iterations=4, num_leaves=15, verbosity=0)
+
+    d = str(tmp_path / "margins")
+    margins = np.linspace(-0.5, 0.5, 200)
+    train_booster(x, y, make_objective("binary", num_class=2), cfg,
+                  init_raw=margins, checkpoint_dir=d, checkpoint_every=2)
+    with pytest.raises(ValueError, match="fingerprint"):
+        train_booster(x, y, make_objective("binary", num_class=2), cfg,
+                      checkpoint_dir=d, checkpoint_every=2)
+
+    warm = _gbdt_fit(x, y, num_iterations=4)
+    d2 = str(tmp_path / "warm")
+    train_booster(x, y, make_objective("binary", num_class=2), cfg,
+                  init_model=warm, checkpoint_dir=d2, checkpoint_every=2)
+    with pytest.raises(ValueError, match="fingerprint"):
+        train_booster(x, y, make_objective("binary", num_class=2), cfg,
+                      checkpoint_dir=d2, checkpoint_every=2)
 
 
 def test_gbdt_estimator_checkpoint_kill_and_resume(tmp_path):
@@ -763,3 +820,12 @@ def test_checkpoint_roundtrip_helpers():
     assert set(out) == {"a", "b"}
     np.testing.assert_array_equal(out["a"], arrays["a"])
     np.testing.assert_array_equal(out["b"], arrays["b"])
+
+
+def test_pack_arrays_rejects_object_dtype():
+    """np.savez would pickle an object array, committing a generation that
+    every allow_pickle=False load then fails to unpack — an
+    integrity-verified checkpoint that can never be resumed. Refused at
+    pack time instead."""
+    with pytest.raises(TypeError, match="object"):
+        pack_arrays({"bad": np.array([{"a": 1}, None], dtype=object)})
