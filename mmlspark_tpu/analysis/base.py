@@ -36,6 +36,8 @@ RULES: Dict[str, str] = {
     "network-call-no-timeout": "HTTPConnection/socket.create_connection without timeout= blocks on a dead peer for the OS TCP default",
     # atomic-write family (atomic_write.py)
     "non-atomic-artifact-write": "open(path, 'w'/'wb') on a final artifact path in a persistence module without the tmp+rename discipline; a crash mid-write destroys the previous good artifact",
+    # stream-path family (full_materialize.py)
+    "full-materialize-in-stream-path": "read_all()/read_table()/whole-table to_numpy inside the streaming tier materializes O(n) rows on host; iterate bounded chunks instead",
     # Params-contract family (params_contract.py)
     "param-converter": "simple Param declared without an explicit type converter",
     "param-doc": "stage or Param missing documentation",

@@ -1,0 +1,176 @@
+"""Stream-path rule: whole-table host materialization in the streaming tier.
+
+`full-materialize-in-stream-path` flags, inside the streaming dataplane
+modules (io/columnar.py, the streamed GBDT fit paths, the prefetch core),
+operations that pull an ENTIRE table or column into host memory — the exact
+O(n) materialization the streaming tier exists to avoid (a 100M-row fit
+whose reader quietly calls ``.read_all()`` is an in-memory fit with extra
+steps, and the peak-RSS bound the bench gates becomes fiction):
+
+- whole-table READS are flagged directly: ``read_table(...)``,
+  ``ParquetFile.read()`` is approximated by ``.read_all()`` /
+  ``.to_table()`` / ``.combine_chunks()`` attribute calls — each of these
+  materializes every row the source holds;
+- values produced by those reads are TAINTED (propagated through simple
+  assignments, ``.column(...)`` / subscript projections — a whole COLUMN of
+  a whole table is still O(n)); host conversions on tainted values —
+  ``.to_numpy()``, ``.to_pandas()``, ``.to_pylist()``, ``np.asarray`` /
+  ``np.array`` / ``np.concatenate`` / ``np.stack`` — are findings;
+- PER-BATCH conversion stays clean: ``batch.column(i).to_numpy()`` on a
+  RecordBatch from ``iter_batches`` is the bounded-chunk idiom, not the
+  bug, and nothing taints it.
+
+A justified whole-table read (a documented small-data materialize path)
+takes a line-level ``# graftcheck: ignore[full-materialize-in-stream-path]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from mmlspark_tpu.analysis.base import Finding
+
+_RULE = "full-materialize-in-stream-path"
+
+#: attribute calls that materialize every row of their receiver
+_MATERIALIZE_ATTRS = {"read_all", "to_table", "combine_chunks"}
+#: call names (attribute or bare) that read a whole table from storage
+_READ_TABLE_NAMES = {"read_table"}
+#: host conversions that copy a (tainted = whole-table) value out of Arrow
+_CONSUME_ATTRS = {"to_numpy", "to_pandas", "to_pylist"}
+#: numpy calls that copy a tainted value into one host array
+_NP_SINKS = {"asarray", "array", "concatenate", "stack", "column_stack",
+             "vstack"}
+_NUMPY_MODULES = {"np", "numpy"}
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _is_materializing_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and _call_name(node) in (_MATERIALIZE_ATTRS | _READ_TABLE_NAMES)
+    )
+
+
+def _is_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    """True when `node` carries a whole-table value: a materializing call,
+    a tainted name, or a projection (.column()/subscript/attribute) of
+    one."""
+    for sub in ast.walk(node):
+        if _is_materializing_call(sub):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+    return False
+
+
+def _walk_scope(body: List[ast.stmt]):
+    """Walk a scope's statements WITHOUT descending into nested function
+    definitions — their locals are a separate taint scope (a module-level
+    `t = read_all()` must not taint an unrelated function's local `t`)."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.append(child)
+
+
+def _scan_scope(body: List[ast.stmt], rel: str,
+                findings: List[Finding]) -> None:
+    # pass 1: taint fixpoint over simple assignments IN THIS SCOPE ONLY
+    # (the walk is not source order; iterate until no new names taint)
+    tainted: Set[str] = set()
+    grew = True
+    while grew:
+        grew = False
+        for node in _walk_scope(body):
+            if isinstance(node, ast.Assign) and _is_tainted(
+                node.value, tainted
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id not in tainted:
+                        tainted.add(tgt.id)
+                        grew = True
+
+    # pass 2: findings
+    for node in _walk_scope(body):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name in (_MATERIALIZE_ATTRS | _READ_TABLE_NAMES):
+            findings.append(Finding(
+                _RULE, rel, node.lineno,
+                f"{name}() materializes the whole table on host inside "
+                "the streaming tier — iterate bounded chunks "
+                "(ParquetFile.iter_batches / ShardReader.iter_chunks) "
+                "instead",
+            ))
+            continue
+        if (
+            name in _CONSUME_ATTRS
+            and isinstance(node.func, ast.Attribute)
+            and _is_tainted(node.func.value, tainted)
+        ):
+            findings.append(Finding(
+                _RULE, rel, node.lineno,
+                f"{name}() on a whole-table value copies every row to "
+                "host — convert per chunk inside the stream loop",
+            ))
+            continue
+        if (
+            isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in _NUMPY_MODULES
+            and node.func.attr in _NP_SINKS
+        ):
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if any(_is_tainted(a, tainted) for a in args):
+                findings.append(Finding(
+                    _RULE, rel, node.lineno,
+                    f"np.{node.func.attr}() over a whole-table value "
+                    "builds an O(n) host array in the streaming tier — "
+                    "keep the conversion per bounded chunk",
+                ))
+
+
+def check_full_materialize(
+    paths: List[str], repo_root: Optional[str] = None
+) -> List[Finding]:
+    repo_root = repo_root or os.getcwd()
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, repo_root)
+        # module body plus each (possibly nested) function scope — every
+        # scope carries its OWN taint set, so a tainted module-level name
+        # cannot false-flag an unrelated function's local of the same name
+        _scan_scope(tree.body, rel, findings)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_scope(node.body, rel, findings)
+    # defensive dedupe by position (scopes are disjoint by construction)
+    seen: Set = set()
+    unique: List[Finding] = []
+    for f in findings:
+        key = (f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique

@@ -136,6 +136,28 @@ def run_all(
             ],
             repo_root=root,
         )
+    if "full-materialize-in-stream-path" in enabled:
+        from mmlspark_tpu.analysis.full_materialize import (
+            check_full_materialize,
+        )
+
+        # scoped to the streaming tier: the modules whose whole contract is
+        # bounded-chunk access (ISSUE 9; docs/dataplane.md "Streaming
+        # ingestion") — a whole-table read here silently turns an
+        # out-of-core fit into an in-memory one
+        stream_files = {
+            os.path.join(package_name, "io", "columnar.py"),
+            os.path.join(package_name, "core", "prefetch.py"),
+            os.path.join(package_name, "gbdt", "binning.py"),
+            os.path.join(package_name, "gbdt", "trainer.py"),
+        }
+        findings += check_full_materialize(
+            [
+                p for p in package_files
+                if os.path.relpath(p, root) in stream_files
+            ],
+            repo_root=root,
+        )
     if enabled & _PARAM_RULES:
         from mmlspark_tpu.analysis.params_contract import check_params_contract
 

@@ -1,30 +1,42 @@
-"""Double-buffered host->HBM batch prefetch for the device dataplane.
+"""Double-buffered host->HBM prefetch for the device dataplane.
 
-Host decode (JPEG/PNG bytes -> numpy, inherently host work) runs in a
-worker pool feeding staged host batches; a single pipeline thread uploads
-each staged batch to device HBM — uploads stay SERIALIZED (BASELINE.md
-round 3: concurrent in-flight device_puts collapse tunnel throughput ~50x)
-— and parks up to `depth` device-resident batches in a bounded queue. The
-consumer drains the queue while the next batch decodes and uploads behind
-it, so batch N+1's h2d overlaps batch N's device compute.
+Host staging work (JPEG decode, Parquet chunk reads, bin transforms —
+anything inherently host-side) runs in a worker pool feeding staged host
+payloads; a single pipeline thread uploads each staged payload to device
+HBM — uploads stay SERIALIZED (BASELINE.md round 3: concurrent in-flight
+device_puts collapse tunnel throughput ~50x) — and parks up to `depth`
+device-resident payloads in a bounded queue. The consumer drains the queue
+while the next payload stages and uploads behind it, so chunk N+1's h2d
+overlaps chunk N's device compute.
 
-Overlap is MEASURED, not assumed: every batch records decode/upload/
+Two public faces share ONE pipeline core:
+
+- ``DeviceChunkPrefetcher`` — the generic tier (ISSUE 9): any lazy iterable
+  of work units, an optional ``stage_fn``, payloads that may be a single
+  ndarray or a tuple/dict of ndarrays (numeric column chunks, binned GBDT
+  chunks). No image imports anywhere on this path.
+- ``DeviceBatchPrefetcher`` — the image tier (ISSUE 7): a full item list
+  chunked by ``batch_size`` with a decode pool, unchanged API.
+
+Overlap is MEASURED, not assumed: every payload records stage/upload/
 request timestamps, `summary()` reports the overlap ratio (1 - consumer
-wait / producer prep, clamped to [0, 1]) and the count of batches whose
+wait / producer prep, clamped to [0, 1]) and the count of payloads whose
 upload finished before the consumer asked — the gateable evidence for
 "prefetch fully overlaps compute" (ROADMAP streaming-ingestion item; the
-bench gate in tests/test_bench_smoke.py). Uploads land in the same
+bench gates in tests/test_bench_smoke.py). Uploads land in the same
 profiling.dataplane_counters() every other transfer point reports to, and
 the loader exports `dataplane_prefetch_*` registry metrics including the
-`dataplane_prefetch_overlap_ratio` gauge.
+`dataplane_prefetch_overlap_ratio` gauge and the
+`dataplane_prefetch_resident_bytes_peak` device-buffer high-water gauge
+(the HBM-footprint-bound evidence: at most ``depth`` chunks ever resident).
 
 Lifecycle: the pipeline thread holds NO strong reference to the public
-DeviceBatchPrefetcher — only to its internal state — and a
-``weakref.finalize`` stops the pipeline when the public object is
-collected. So a consumer that breaks out of a bare ``for`` loop and drops
-the iterator cannot strand a producer spinning on a full queue pinning
-device batches; explicit ``close()`` (or the context manager) remains the
-deterministic way to release resources immediately.
+prefetcher — only to its internal state — and a ``weakref.finalize`` stops
+the pipeline when the public object is collected. So a consumer that breaks
+out of a bare ``for`` loop and drops the iterator cannot strand a producer
+spinning on a full queue pinning device batches; explicit ``close()`` (or
+the context manager) remains the deterministic way to release resources
+immediately.
 """
 
 from __future__ import annotations
@@ -33,8 +45,9 @@ import queue
 import threading
 import time
 import weakref
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
@@ -62,13 +75,51 @@ def _metrics() -> Dict[str, Any]:
             "dataplane_prefetch_overlap_ratio",
             "1 - consumer wait / producer prep for the most recently "
             "finished prefetch loop (1.0 = prep fully hidden)")
+        _METRICS["resident_peak"] = reg.gauge(
+            "dataplane_prefetch_resident_bytes_peak",
+            "High-water mark of device bytes parked in the prefetch queue "
+            "for the most recently finished prefetch loop (the depth-bounded "
+            "HBM footprint of streaming ingestion)")
     return _METRICS
+
+
+def upload_host_chunk(host: Any, sharding: Any = None) -> Any:
+    """Counted host->HBM upload of one staged payload: a single ndarray or
+    a tuple/list/dict of ndarrays (each leaf uploaded — and counted in
+    dataplane_counters — separately; the device result mirrors the host
+    structure). The ONE pipeline-entry transfer of a streamed chunk."""
+    import jax
+
+    from mmlspark_tpu.utils.profiling import dataplane_counters
+
+    def put(a):
+        a = np.asarray(a)
+        dataplane_counters().record_h2d(a.nbytes)
+        return (
+            jax.device_put(a) if sharding is None
+            else jax.device_put(a, sharding)
+        )
+
+    if isinstance(host, dict):
+        return {k: put(v) for k, v in host.items()}
+    if isinstance(host, (tuple, list)):
+        return type(host)(put(v) for v in host)
+    return put(host)
+
+
+def payload_nbytes(host: Any) -> int:
+    """Host bytes of one staged payload (sum over leaves)."""
+    if isinstance(host, dict):
+        return sum(np.asarray(v).nbytes for v in host.values())
+    if isinstance(host, (tuple, list)):
+        return sum(np.asarray(v).nbytes for v in host)
+    return np.asarray(host).nbytes
 
 
 class _PrefetchState:
     """Everything the pipeline thread touches — shared with (but not
-    owning) the public DeviceBatchPrefetcher, so the thread cannot keep an
-    abandoned prefetcher alive."""
+    owning) the public prefetcher, so the thread cannot keep an abandoned
+    prefetcher alive."""
 
     def __init__(self, depth: int):
         self.q: "queue.Queue" = queue.Queue(maxsize=depth)
@@ -76,44 +127,54 @@ class _PrefetchState:
         self.error: Optional[BaseException] = None
         self.timeline: List[Dict[str, float]] = []
         self.tl_lock = threading.Lock()
+        self.resident_bytes = 0
+        self.resident_peak = 0
 
 
 def _produce(
     state: _PrefetchState,
-    chunks: List[List[Any]],
-    decode_fn: Callable[[List[Any]], np.ndarray],
+    source: Iterable[Any],
+    stage_fn: Callable[[Any], Any],
     workers: int,
     upload: bool,
     sharding: Any,
 ) -> None:
-    def stage(chunk):
+    def stage(item):
         t0 = time.perf_counter()
-        host = decode_fn(chunk)
+        host = stage_fn(item)
         return host, time.perf_counter() - t0
 
     try:
+        source = iter(source)
         with ThreadPoolExecutor(
-            max_workers=workers, thread_name_prefix="prefetch-decode"
+            max_workers=workers, thread_name_prefix="prefetch-stage"
         ) as pool:
-            # sliding submit window: keeps the pool busy without letting
-            # decoded host batches pile up unboundedly ahead of uploads
+            # sliding submit window over the LAZY source: keeps the pool busy
+            # without letting staged host chunks pile up unboundedly ahead of
+            # uploads — and never materializes the work list (a streamed
+            # shard reader may be far larger than host RAM)
             window = workers + 1
-            futures = [pool.submit(stage, c) for c in chunks[:window]]
-            next_submit = len(futures)
-            for idx in range(len(chunks)):
+            futures: "deque" = deque()
+            for _ in range(window):
+                try:
+                    futures.append(pool.submit(stage, next(source)))
+                except StopIteration:
+                    break
+            idx = 0
+            while futures:
                 if state.stop.is_set():
                     break
-                host, decode_s = futures[idx].result()
-                if next_submit < len(chunks):
-                    futures.append(pool.submit(stage, chunks[next_submit]))
-                    next_submit += 1
+                host, decode_s = futures.popleft().result()
+                try:
+                    futures.append(pool.submit(stage, next(source)))
+                except StopIteration:
+                    pass
                 t_up = time.perf_counter()
+                nbytes = payload_nbytes(host)
                 if upload:
                     import jax
 
-                    from mmlspark_tpu.images.device_ops import upload_batch
-
-                    batch = upload_batch(host, sharding)
+                    batch = upload_host_chunk(host, sharding)
                     # block: "upload done" must mean bytes ON the device,
                     # and serialized uploads are the measured fast path
                     # for the tunnel-attached chip
@@ -128,15 +189,21 @@ def _produce(
                     "upload_done_t": upload_done,
                     "requested_t": -1.0,
                     "wait_s": -1.0,
+                    "nbytes": float(nbytes),
                 }
                 with state.tl_lock:
                     state.timeline.append(entry)
+                    state.resident_bytes += nbytes
+                    state.resident_peak = max(
+                        state.resident_peak, state.resident_bytes
+                    )
                 while not state.stop.is_set():
                     try:
                         state.q.put((idx, batch, entry), timeout=0.05)
                         break
                     except queue.Full:
                         continue
+                idx += 1
     except BaseException as e:  # surfaced to the consumer in __next__
         state.error = e
     finally:
@@ -165,9 +232,177 @@ def _produce(
                     continue
 
 
-class DeviceBatchPrefetcher:
+class _ChunkPipeline:
+    """The shared pipeline core: lazy source -> staged host payloads ->
+    serialized counted uploads -> depth-bounded device queue. Subclasses
+    only shape the constructor surface."""
+
+    def __init__(
+        self,
+        source: Iterable[Any],
+        stage_fn: Callable[[Any], Any],
+        depth: int = 2,
+        workers: int = 1,
+        upload: bool = True,
+        sharding: Any = None,
+    ):
+        self._state = _PrefetchState(max(1, int(depth)))
+        self._started = False
+        # the thread closes over state/source/stage_fn only — NOT self —
+        # so an abandoned prefetcher is collectable, and this finalizer
+        # then stops the producer (it also runs at interpreter shutdown)
+        self._finalizer = weakref.finalize(self, self._state.stop.set)
+        self._thread = threading.Thread(
+            target=_produce,
+            args=(self._state, source, stage_fn,
+                  max(1, int(workers)), upload, sharding),
+            name="prefetch-pipeline", daemon=True,
+        )
+
+    # -- consumer side -----------------------------------------------------
+
+    def __iter__(self) -> "_ChunkPipeline":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def __next__(self) -> Any:
+        if not self._started:
+            self.__iter__()
+        state = self._state
+        t_req = time.perf_counter()
+        while True:
+            try:
+                item = state.q.get(timeout=0.05)
+                break
+            except queue.Empty:
+                # close()/finalize can race a consumer already parked in
+                # get(): once stop is set and the queue is drained, nothing
+                # more is coming — finish rather than block forever
+                if state.stop.is_set():
+                    item = _SENTINEL
+                    break
+        if item is _SENTINEL:
+            self._finish()
+            if state.error is not None:
+                raise state.error
+            raise StopIteration
+        idx, batch, entry = item
+        now = time.perf_counter()
+        with state.tl_lock:
+            entry["requested_t"] = t_req
+            entry["wait_s"] = now - t_req
+            state.resident_bytes -= int(entry["nbytes"])
+        m = _metrics()
+        m["batches"].inc()
+        if idx > 0 and entry["upload_done_t"] <= t_req:
+            m["overlapped"].inc()
+        return batch
+
+    def __enter__(self) -> "_ChunkPipeline":
+        return self.__iter__()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        """Stop the pipeline (idempotent; safe after partial consumption)."""
+        self._state.stop.set()
+        if self._started:
+            self._thread.join(timeout=5.0)
+
+    def _finish(self) -> None:
+        s = self.summary()
+        m = _metrics()
+        m["ratio"].set(s["overlap_ratio"])
+        m["resident_peak"].set(s["resident_bytes_peak"])
+
+    # -- evidence ----------------------------------------------------------
+
+    def timeline(self) -> List[Dict[str, float]]:
+        """Per-batch timestamps (perf_counter clock): decode_s, upload_s,
+        upload_done_t, requested_t, wait_s, nbytes. The overlap proof
+        compares upload_done_t of batch N+1 against the consumer's compute
+        window for batch N."""
+        state = self._state
+        with state.tl_lock:
+            return [dict(e) for e in state.timeline]
+
+    def summary(self) -> Dict[str, float]:
+        """Overlap evidence: batches, overlapped_batches (upload finished
+        before the consumer asked), wait vs prep seconds, overlap_ratio =
+        1 - wait/prep clamped to [0, 1], and resident_bytes_peak (the
+        depth-bounded device-buffer high-water)."""
+        state = self._state
+        with state.tl_lock:
+            consumed = [e for e in state.timeline if e["wait_s"] >= 0]
+            # the first batch can never overlap anything: nothing was
+            # computing while it staged, so it is excluded from the ratio
+            tail = [e for e in consumed if e["index"] > 0]
+            wait = sum(e["wait_s"] for e in tail)
+            prep = sum(e["decode_s"] + e["upload_s"] for e in tail)
+            overlapped = sum(
+                1 for e in tail if e["upload_done_t"] <= e["requested_t"]
+            )
+            ratio = 1.0 - (wait / prep) if prep > 0 else 0.0
+            return {
+                "batches": len(consumed),
+                "overlapped_batches": overlapped,
+                "overlap_ratio": round(max(0.0, min(1.0, ratio)), 4),
+                "wait_s": round(wait, 4),
+                "prep_s": round(prep, 4),
+                "resident_bytes_peak": int(state.resident_peak),
+            }
+
+
+class DeviceChunkPrefetcher(_ChunkPipeline):
+    """Iterate device-resident chunks staged and uploaded ahead of the
+    consumer — the GENERIC double-buffer tier (numeric column chunks,
+    binned GBDT chunks, any host payload shaped as an ndarray or a
+    tuple/dict of ndarrays).
+
+    Parameters
+    ----------
+    chunks: a LAZY iterable of work units — consumed one sliding window at
+        a time, never materialized (a shard reader's chunk iterator can be
+        far larger than host RAM).
+    stage_fn: work unit -> host payload, run in the worker pool (None:
+        the work units already ARE host payloads). Per-chunk host work
+        (file read, decode, bin transform) belongs here.
+    depth: device chunks parked ahead of the consumer (the double buffer;
+        2 keeps one uploading while one is consumed). This bounds the
+        streaming HBM footprint at depth * chunk_bytes, measured by
+        `summary()["resident_bytes_peak"]`.
+    workers: staging pool size (stage parallelism; uploads stay serial).
+    upload: False yields host payloads instead (stage-only prefetch).
+
+    Use as an iterator (or context manager for early-exit cleanup):
+
+        with DeviceChunkPrefetcher(reader.iter_chunks(), stage) as pf:
+            for dev_chunk in pf:
+                hist += kernel(dev_chunk)    # overlaps the next upload
+        pf.summary()["overlap_ratio"]
+    """
+
+    def __init__(
+        self,
+        chunks: Iterable[Any],
+        stage_fn: Optional[Callable[[Any], Any]] = None,
+        depth: int = 2,
+        workers: int = 1,
+        upload: bool = True,
+        sharding: Any = None,
+    ):
+        super().__init__(
+            chunks, stage_fn if stage_fn is not None else (lambda c: c),
+            depth=depth, workers=workers, upload=upload, sharding=sharding,
+        )
+
+
+class DeviceBatchPrefetcher(_ChunkPipeline):
     """Iterate device-resident batches decoded and uploaded ahead of the
-    consumer.
+    consumer — the image-tier face of the pipeline (ISSUE 7).
 
     Parameters
     ----------
@@ -206,104 +441,7 @@ class DeviceBatchPrefetcher:
         items = list(items)
         bs = int(batch_size)
         chunks = [items[i: i + bs] for i in range(0, len(items), bs)]
-        self._state = _PrefetchState(max(1, int(depth)))
-        self._started = False
-        # the thread closes over state/chunks/decode_fn only — NOT self —
-        # so an abandoned prefetcher is collectable, and this finalizer
-        # then stops the producer (it also runs at interpreter shutdown)
-        self._finalizer = weakref.finalize(self, self._state.stop.set)
-        self._thread = threading.Thread(
-            target=_produce,
-            args=(self._state, chunks, decode_fn,
-                  max(1, int(workers)), upload, sharding),
-            name="prefetch-pipeline", daemon=True,
+        super().__init__(
+            chunks, decode_fn,
+            depth=depth, workers=workers, upload=upload, sharding=sharding,
         )
-
-    # -- consumer side -----------------------------------------------------
-
-    def __iter__(self) -> "DeviceBatchPrefetcher":
-        if not self._started:
-            self._started = True
-            self._thread.start()
-        return self
-
-    def __next__(self) -> Any:
-        if not self._started:
-            self.__iter__()
-        state = self._state
-        t_req = time.perf_counter()
-        while True:
-            try:
-                item = state.q.get(timeout=0.05)
-                break
-            except queue.Empty:
-                # close()/finalize can race a consumer already parked in
-                # get(): once stop is set and the queue is drained, nothing
-                # more is coming — finish rather than block forever
-                if state.stop.is_set():
-                    item = _SENTINEL
-                    break
-        if item is _SENTINEL:
-            self._finish()
-            if state.error is not None:
-                raise state.error
-            raise StopIteration
-        idx, batch, entry = item
-        now = time.perf_counter()
-        entry["requested_t"] = t_req
-        entry["wait_s"] = now - t_req
-        m = _metrics()
-        m["batches"].inc()
-        if idx > 0 and entry["upload_done_t"] <= t_req:
-            m["overlapped"].inc()
-        return batch
-
-    def __enter__(self) -> "DeviceBatchPrefetcher":
-        return self.__iter__()
-
-    def __exit__(self, *exc) -> None:
-        self.close()
-
-    def close(self) -> None:
-        """Stop the pipeline (idempotent; safe after partial consumption)."""
-        self._state.stop.set()
-        if self._started:
-            self._thread.join(timeout=5.0)
-
-    def _finish(self) -> None:
-        _metrics()["ratio"].set(self.summary()["overlap_ratio"])
-
-    # -- evidence ----------------------------------------------------------
-
-    def timeline(self) -> List[Dict[str, float]]:
-        """Per-batch timestamps (perf_counter clock): decode_s, upload_s,
-        upload_done_t, requested_t, wait_s. The overlap proof compares
-        upload_done_t of batch N+1 against the consumer's compute window
-        for batch N."""
-        state = self._state
-        with state.tl_lock:
-            return [dict(e) for e in state.timeline]
-
-    def summary(self) -> Dict[str, float]:
-        """Overlap evidence: batches, overlapped_batches (upload finished
-        before the consumer asked), wait vs prep seconds, and
-        overlap_ratio = 1 - wait/prep clamped to [0, 1]."""
-        state = self._state
-        with state.tl_lock:
-            consumed = [e for e in state.timeline if e["wait_s"] >= 0]
-            # the first batch can never overlap anything: nothing was
-            # computing while it staged, so it is excluded from the ratio
-            tail = [e for e in consumed if e["index"] > 0]
-            wait = sum(e["wait_s"] for e in tail)
-            prep = sum(e["decode_s"] + e["upload_s"] for e in tail)
-            overlapped = sum(
-                1 for e in tail if e["upload_done_t"] <= e["requested_t"]
-            )
-            ratio = 1.0 - (wait / prep) if prep > 0 else 0.0
-            return {
-                "batches": len(consumed),
-                "overlapped_batches": overlapped,
-                "overlap_ratio": round(max(0.0, min(1.0, ratio)), 4),
-                "wait_s": round(wait, 4),
-                "prep_s": round(prep, 4),
-            }

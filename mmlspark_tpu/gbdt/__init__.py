@@ -26,6 +26,7 @@ from mmlspark_tpu.gbdt.estimators import (
     LightGBMRegressor,
 )
 from mmlspark_tpu.gbdt.booster import Booster
+from mmlspark_tpu.gbdt.trainer import train_booster_from_reader
 
 __all__ = [
     "Booster",
@@ -33,4 +34,5 @@ __all__ = [
     "LightGBMClassifier",
     "LightGBMRegressionModel",
     "LightGBMRegressor",
+    "train_booster_from_reader",
 ]

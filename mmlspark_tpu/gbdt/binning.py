@@ -42,21 +42,96 @@ class BinMapper:
         return feature in self.categorical_indexes
 
     def fit(self, x: np.ndarray) -> "BinMapper":
-        # f32 throughout: scoring runs in f32 on device, so bin edges must be
+        # f32 first: scoring runs in f32 on device, so bin edges must be
         # f32-representable or boundary values route differently at predict
-        x = np.asarray(x, dtype=np.float32).astype(np.float64)
+        x = np.asarray(x, dtype=np.float32)
         n, f = x.shape
-        self.num_features = f
         rng = np.random.default_rng(self.seed)
         rows = (
             rng.choice(n, self.sample_cap, replace=False)
             if n > self.sample_cap
             else np.arange(n)
         )
+        return self._fit_edges(x[rows])
+
+    def fit_from_chunks(
+        self,
+        chunks,
+        total_rows: Optional[int] = None,
+    ) -> "BinMapper":
+        """Fit edges from a bounded stream of (rows, f) chunks — the
+        out-of-core path: peak memory is O(sample_cap * f), never O(n * f).
+
+        With ``total_rows`` (shard readers know it from footer metadata),
+        the row sample is IDENTICAL to ``fit()``'s over the concatenated
+        matrix — same seed, same rng.choice draw — so streamed and
+        in-memory fits produce bit-identical edges. Without it, a
+        deterministic reservoir over the stream stands in (same chunk
+        order -> same sample, but not fit()-identical).
+        """
+        cap = self.sample_cap
+        rng = np.random.default_rng(self.seed)
+        sample: Optional[np.ndarray] = None
+        if total_rows is not None and total_rows > cap:
+            chosen = rng.choice(int(total_rows), cap, replace=False)
+            order = np.argsort(chosen, kind="stable")
+            sorted_chosen = chosen[order]
+        seen = 0
+        for chunk in chunks:
+            chunk = np.asarray(chunk, dtype=np.float32)
+            rows = chunk.shape[0]
+            if sample is None:
+                width = cap if total_rows is None or total_rows > cap \
+                    else int(total_rows)
+                sample = np.empty((width, chunk.shape[1]), np.float32)
+            if total_rows is not None and total_rows > cap:
+                # gather exactly fit()'s sampled rows as they stream by:
+                # sorted global ids inside [seen, seen+rows) map back to
+                # their (unsorted) slots in the fit() sample order
+                a = np.searchsorted(sorted_chosen, seen)
+                b = np.searchsorted(sorted_chosen, seen + rows)
+                sample[order[a:b]] = chunk[sorted_chosen[a:b] - seen]
+            elif total_rows is not None:
+                sample[seen: seen + rows] = chunk
+            else:
+                # algorithm-R reservoir, vectorized; duplicate slot draws
+                # within one chunk keep the LAST row (sequential semantics)
+                lo = seen
+                if lo < cap:  # reservoir fill phase (width is always cap)
+                    head = min(cap - lo, rows)
+                    sample[lo: lo + head] = chunk[:head]
+                else:
+                    head = 0
+                tail = np.arange(lo + head, lo + rows)
+                if tail.size:
+                    js = rng.integers(0, tail + 1)
+                    keep = np.flatnonzero(js < cap)
+                    # last occurrence per slot wins, deterministically
+                    slots, last = np.unique(js[keep][::-1],
+                                            return_index=True)
+                    src = keep[::-1][last] + head
+                    sample[slots] = chunk[src]
+            seen += rows
+        if sample is None:
+            raise ValueError("fit_from_chunks got an empty stream")
+        if total_rows is not None and seen != total_rows:
+            raise ValueError(
+                f"stream yielded {seen} rows, reader claimed {total_rows}"
+            )
+        if total_rows is None and seen < sample.shape[0]:
+            sample = sample[:seen]
+        return self._fit_edges(sample)
+
+    def _fit_edges(self, sample: np.ndarray) -> "BinMapper":
+        """Shared edge computation over the (bounded) f32 row sample."""
+        f = sample.shape[1]
+        self.num_features = f
         self.upper_edges = []
         self.n_bins = []
         for j in range(f):
-            v = x[rows, j]
+            # one column upcast at a time (exact f32->f64): peak temp O(n),
+            # not the whole-matrix f64 copy the pre-streaming fit made
+            v = sample[:, j].astype(np.float64)
             v = v[~np.isnan(v)]
             if self.is_categorical(j):
                 # categorical slots are already small non-negative ints
@@ -80,15 +155,27 @@ class BinMapper:
             self.n_bins.append(len(edges) + 1)
         return self
 
-    def transform(self, x: np.ndarray) -> np.ndarray:
-        """-> (n, f) int32 bins (0 = missing)."""
-        x = np.asarray(x, dtype=np.float32).astype(np.float64)
+    def transform(
+        self, x: np.ndarray, out: Optional[np.ndarray] = None
+    ) -> np.ndarray:
+        """-> (n, f) int32 bins (0 = missing).
+
+        Chunk-friendly (THE streaming hot path): the input casts to f32
+        once (free when it already is) and each feature column upcasts to
+        f64 alone, so peak temporary memory is O(n) instead of the
+        whole-matrix f64 copy the pre-streaming version made. ``out``
+        writes into a caller buffer (any int dtype wide enough for the bin
+        ids — the spill path passes uint8 when max_n_bins <= 256)."""
+        x = np.asarray(x, dtype=np.float32)
         n, f = x.shape
         if f != self.num_features:
             raise ValueError(f"expected {self.num_features} features, got {f}")
-        out = np.zeros((n, f), dtype=np.int32)
+        if out is None:
+            out = np.zeros((n, f), dtype=np.int32)
+        elif out.shape != (n, f):
+            raise ValueError(f"out shape {out.shape} != {(n, f)}")
         for j in range(f):
-            v = x[:, j]
+            v = x[:, j].astype(np.float64)  # exact upcast, one column
             nan = np.isnan(v)
             if self.is_categorical(j):
                 cats = np.clip(v, 0, self.n_bins[j] - 2).astype(np.int32)

@@ -288,6 +288,189 @@ def _hist_masked(bins, grad, hess, mask, num_bins: int, n_bins_static=None,
     )
 
 
+def _best_split_impl(
+    hist,            # (F, B, 3) f32 — this leaf's histogram
+    depth_ok,        # traced bool — depth constraint for this leaf
+    n_bins_arr,      # (F,) int32
+    categorical_arr, # (F,) bool
+    feature_mask,    # (F,) bool
+    min_data, min_hess, l1, l2,  # traced f32 scalars
+    *,
+    num_bins: int,
+    max_cat_threshold: int,
+    n_bins_static=None,
+    cat_static=None,
+):
+    """Best split for one leaf from its (F, B, 3) histogram — THE split
+    rule of the fused grower, extracted so the streamed out-of-core grower
+    (trainer.py `_stream_grow_tree`) decides splits with the exact same
+    traced arithmetic from chunk-accumulated histograms.
+
+    Returns (gain, feat, thr_bin, is_cat, member(B,), left(3,), right(3,));
+    gain == -inf when no valid split. Semantics documented on
+    _grow_tree_body (this is its former `best_split` closure, verbatim,
+    with the closure state passed as arguments)."""
+    import jax.numpy as jnp
+
+    F = hist.shape[0]
+    B = num_bins
+    NEG = jnp.float32(-jnp.inf)
+
+    def thresh(g):
+        return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
+
+    def score(g, h):
+        t = thresh(g)
+        return t * t / jnp.maximum(h + l2, 1e-35)
+
+    g, h, c = hist[..., 0], hist[..., 1], hist[..., 2]
+    tg, th, tc = g.sum(1), h.sum(1), c.sum(1)          # (F,)
+    parent = score(tg, th)
+    leaf_ok = (tc >= 2.0 * min_data) & feature_mask & depth_ok
+
+    # -- numerical: left = bins [0..t], t in [1, nb-2] ------------------
+    cg, ch, cc = jnp.cumsum(g, 1), jnp.cumsum(h, 1), jnp.cumsum(c, 1)
+    tpos = jnp.arange(B)[None, :]
+    gl, hl, cl = cg, ch, cc
+    gr, hr, cr = tg[:, None] - gl, th[:, None] - hl, tc[:, None] - cl
+    nvalid = (
+        (tpos >= 1)
+        & (tpos <= n_bins_arr[:, None] - 2)
+        & (cl >= min_data) & (cr >= min_data)
+        & (hl >= min_hess) & (hr >= min_hess)
+        & (~categorical_arr)[:, None]
+        & leaf_ok[:, None]
+    )
+    ngain = jnp.where(
+        nvalid, score(gl, hl) + score(gr, hr) - parent[:, None], NEG
+    )
+    nbest_t = jnp.argmax(ngain, axis=1)                 # (F,) first max
+    nbest_gain = jnp.take_along_axis(ngain, nbest_t[:, None], 1)[:, 0]
+
+    # -- categorical: prefix cuts in g/h-ratio order, both directions ---
+    # Argsort-free: the cut "after element i of the stable sort" is the
+    # set {j : key_j < key_i or (key_j == key_i and j <= i)}. Building
+    # that as a (Fc, Bc, Bc) comparison matrix and taking prefix stats
+    # with a small einsum keeps the work on the MXU — the former double
+    # argsort + gather chain cost ~1 ms per best_split on TPU
+    # (BASELINE.md round-4 ablation). Cut SETS are identical to the
+    # sorted-prefix formulation; only the tie-break among equal-gain
+    # cuts differs (first original bin vs first sorted position).
+    #
+    # When the categorical layout is known at trace time (cat_static +
+    # n_bins_static), the whole section shrinks to the CATEGORICAL
+    # features at their true bin width: Adult's (14, 255, 255)
+    # comparison tensors become (8, 48, 48) — ~50x fewer cells per
+    # best_split, the dominant per-iteration cost after the histogram
+    # grouping.
+    if cat_static is not None:
+        cat_idx = tuple(f for f, yes in enumerate(cat_static) if yes)
+    else:
+        cat_idx = tuple(range(F))
+    if not cat_idx:
+        # all-numeric (known at trace time): skip the categorical
+        # machinery entirely — nothing to compute, nothing to mask
+        f_star = jnp.argmax(nbest_gain)
+        gain = nbest_gain[f_star]
+        t_star = nbest_t[f_star]
+        member = jnp.arange(B) <= t_star
+        left = jnp.stack(
+            [cg[f_star, t_star], ch[f_star, t_star], cc[f_star, t_star]]
+        )
+        total = jnp.stack([tg[f_star], th[f_star], tc[f_star]])
+        return (
+            gain, f_star.astype(jnp.int32), t_star.astype(jnp.int32),
+            jnp.asarray(False), member, left, total - left,
+        )
+    if n_bins_static is not None and cat_static is not None:
+        bc_needed = max(n_bins_static[f] for f in cat_idx)
+        Bc = min(B, -(-bc_needed // 8) * 8)
+    else:
+        Bc = B
+    Fc = len(cat_idx)
+    ci_arr = jnp.asarray(cat_idx, jnp.int32)
+    g_c = g[ci_arr, :Bc]
+    h_c = h[ci_arr, :Bc]
+    c_c = c[ci_arr, :Bc]
+    tg_c, th_c, tc_c = tg[ci_arr], th[ci_arr], tc[ci_arr]
+    parent_c = parent[ci_arr]
+    nb_c = n_bins_arr[ci_arr]
+    leaf_ok_c = leaf_ok[ci_arr]
+    catf_c = categorical_arr[ci_arr]
+
+    bpos = jnp.arange(Bc)
+    present = (c_c > 0) & (bpos[None, :] >= 1) & (bpos[None, :] < nb_c[:, None])
+    ratio = g_c / (h_c + l2 + 1e-12)
+    kcats = present.sum(1)                              # (Fc,)
+    lim = jnp.minimum(kcats - 1, max_cat_threshold)
+    stats3 = jnp.stack([g_c, h_c, c_c], axis=-1)        # (Fc, Bc, 3)
+
+    def one_dir(key):
+        tie = (key[:, None, :] == key[:, :, None]) & (
+            bpos[None, None, :] <= bpos[None, :, None]
+        )
+        le = (key[:, None, :] < key[:, :, None]) | tie   # (Fc, Bc, Bc)
+        pref = jnp.einsum(
+            "fij,fjv->fiv", le.astype(jnp.float32), stats3,
+            preferred_element_type=jnp.float32,
+        )                                                # (Fc, Bc, 3)
+        cgl, chl, ccl = pref[..., 0], pref[..., 1], pref[..., 2]
+        cgr = tg_c[:, None] - cgl
+        chr_ = th_c[:, None] - chl
+        ccr = tc_c[:, None] - ccl
+        pos = le.sum(-1) - 1                             # sorted position
+        cvalid = (
+            (pos < lim[:, None])
+            & (ccl >= min_data) & (ccr >= min_data)
+            & (chl >= min_hess) & (chr_ >= min_hess)
+            & catf_c[:, None]
+            & leaf_ok_c[:, None]
+        )
+        cgain = jnp.where(
+            cvalid, score(cgl, chl) + score(cgr, chr_) - parent_c[:, None], NEG
+        )
+        ibest = jnp.argmax(cgain, axis=1)                # original bin id
+        return le, ibest, jnp.take_along_axis(cgain, ibest[:, None], 1)[:, 0], pref
+
+    inf = jnp.float32(jnp.inf)
+    key_asc = jnp.where(present, ratio, inf)
+    key_desc = jnp.where(present, -ratio, inf)
+    le1, i1, g1, p1 = one_dir(key_asc)
+    le2, i2, g2, p2 = one_dir(key_desc)
+    use2 = g2 > g1                                      # strict, host parity
+    ci = jnp.where(use2, i2, i1)
+    cbest_gain_c = jnp.maximum(g1, g2)                  # (Fc,)
+    # scatter reduced gains back to full feature space
+    cbest_gain = jnp.full((F,), NEG).at[ci_arr].set(cbest_gain_c)
+
+    # -- combine per feature, then first-argmax over features -----------
+    fgain = jnp.maximum(nbest_gain, cbest_gain)
+    use_cat_f = cbest_gain > nbest_gain
+    f_star = jnp.argmax(fgain)
+    gain = fgain[f_star]
+    is_cat = use_cat_f[f_star] & categorical_arr[f_star]
+    t_star = nbest_t[f_star]
+    # member mask, True = left
+    num_member = jnp.arange(B) <= t_star
+    # f_star's slot in the reduced view (cat_idx is sorted); clamped
+    # garbage when f_star is numeric — masked out by is_cat
+    fpos = jnp.clip(
+        jnp.searchsorted(ci_arr, f_star).astype(jnp.int32), 0, Fc - 1
+    )
+    cif = ci[fpos]
+    cat_member_c = jnp.where(use2[fpos], le2[fpos, cif], le1[fpos, cif])
+    cat_member = jnp.zeros(B, bool).at[:Bc].set(cat_member_c)
+    member = jnp.where(is_cat, cat_member, num_member)
+    # left stats at the chosen cut
+    left_num = jnp.stack([cg[f_star, t_star], ch[f_star, t_star], cc[f_star, t_star]])
+    left_cat = jnp.where(use2[fpos], p2[fpos, cif], p1[fpos, cif])
+    left = jnp.where(is_cat, left_cat, left_num)
+    total = jnp.stack([tg[f_star], th[f_star], tc[f_star]])
+    right = total - left
+    thr_bin = jnp.where(is_cat, -1, t_star).astype(jnp.int32)
+    return gain, f_star.astype(jnp.int32), thr_bin, is_cat, member, left, right
+
+
 def _grow_tree_body(
     bins,            # (n, F) int32
     grad,            # (n,) f32
@@ -355,153 +538,15 @@ def _grow_tree_body(
 
     def best_split(hist, depth_ok):
         """hist (F,B,3) -> (gain, feat, thr_bin, is_cat, member(B,),
-        left(3,), right(3,)). gain=-inf when no valid split."""
-        g, h, c = hist[..., 0], hist[..., 1], hist[..., 2]
-        tg, th, tc = g.sum(1), h.sum(1), c.sum(1)          # (F,)
-        parent = score(tg, th)
-        leaf_ok = (tc >= 2.0 * min_data) & feature_mask & depth_ok
-
-        # -- numerical: left = bins [0..t], t in [1, nb-2] ------------------
-        cg, ch, cc = jnp.cumsum(g, 1), jnp.cumsum(h, 1), jnp.cumsum(c, 1)
-        tpos = jnp.arange(B)[None, :]
-        gl, hl, cl = cg, ch, cc
-        gr, hr, cr = tg[:, None] - gl, th[:, None] - hl, tc[:, None] - cl
-        nvalid = (
-            (tpos >= 1)
-            & (tpos <= n_bins_arr[:, None] - 2)
-            & (cl >= min_data) & (cr >= min_data)
-            & (hl >= min_hess) & (hr >= min_hess)
-            & (~categorical_arr)[:, None]
-            & leaf_ok[:, None]
+        left(3,), right(3,)). gain=-inf when no valid split. The shared
+        rule lives in _best_split_impl (the streamed grower calls it on
+        chunk-accumulated histograms)."""
+        return _best_split_impl(
+            hist, depth_ok, n_bins_arr, categorical_arr, feature_mask,
+            min_data, min_hess, l1, l2,
+            num_bins=B, max_cat_threshold=max_cat_threshold,
+            n_bins_static=n_bins_static, cat_static=cat_static,
         )
-        ngain = jnp.where(
-            nvalid, score(gl, hl) + score(gr, hr) - parent[:, None], NEG
-        )
-        nbest_t = jnp.argmax(ngain, axis=1)                 # (F,) first max
-        nbest_gain = jnp.take_along_axis(ngain, nbest_t[:, None], 1)[:, 0]
-
-        # -- categorical: prefix cuts in g/h-ratio order, both directions ---
-        # Argsort-free: the cut "after element i of the stable sort" is the
-        # set {j : key_j < key_i or (key_j == key_i and j <= i)}. Building
-        # that as a (Fc, Bc, Bc) comparison matrix and taking prefix stats
-        # with a small einsum keeps the work on the MXU — the former double
-        # argsort + gather chain cost ~1 ms per best_split on TPU
-        # (BASELINE.md round-4 ablation). Cut SETS are identical to the
-        # sorted-prefix formulation; only the tie-break among equal-gain
-        # cuts differs (first original bin vs first sorted position).
-        #
-        # When the categorical layout is known at trace time (cat_static +
-        # n_bins_static), the whole section shrinks to the CATEGORICAL
-        # features at their true bin width: Adult's (14, 255, 255)
-        # comparison tensors become (8, 48, 48) — ~50x fewer cells per
-        # best_split, the dominant per-iteration cost after the histogram
-        # grouping.
-        if cat_static is not None:
-            cat_idx = tuple(f for f, yes in enumerate(cat_static) if yes)
-        else:
-            cat_idx = tuple(range(F))
-        if not cat_idx:
-            # all-numeric (known at trace time): skip the categorical
-            # machinery entirely — nothing to compute, nothing to mask
-            f_star = jnp.argmax(nbest_gain)
-            gain = nbest_gain[f_star]
-            t_star = nbest_t[f_star]
-            member = jnp.arange(B) <= t_star
-            left = jnp.stack(
-                [cg[f_star, t_star], ch[f_star, t_star], cc[f_star, t_star]]
-            )
-            total = jnp.stack([tg[f_star], th[f_star], tc[f_star]])
-            return (
-                gain, f_star.astype(jnp.int32), t_star.astype(jnp.int32),
-                jnp.asarray(False), member, left, total - left,
-            )
-        if n_bins_static is not None and cat_static is not None:
-            bc_needed = max(n_bins_static[f] for f in cat_idx)
-            Bc = min(B, -(-bc_needed // 8) * 8)
-        else:
-            Bc = B
-        Fc = len(cat_idx)
-        ci_arr = jnp.asarray(cat_idx, jnp.int32)
-        g_c = g[ci_arr, :Bc]
-        h_c = h[ci_arr, :Bc]
-        c_c = c[ci_arr, :Bc]
-        tg_c, th_c, tc_c = tg[ci_arr], th[ci_arr], tc[ci_arr]
-        parent_c = parent[ci_arr]
-        nb_c = n_bins_arr[ci_arr]
-        leaf_ok_c = leaf_ok[ci_arr]
-        catf_c = categorical_arr[ci_arr]
-
-        bpos = jnp.arange(Bc)
-        present = (c_c > 0) & (bpos[None, :] >= 1) & (bpos[None, :] < nb_c[:, None])
-        ratio = g_c / (h_c + l2 + 1e-12)
-        kcats = present.sum(1)                              # (Fc,)
-        lim = jnp.minimum(kcats - 1, max_cat_threshold)
-        stats3 = jnp.stack([g_c, h_c, c_c], axis=-1)        # (Fc, Bc, 3)
-
-        def one_dir(key):
-            tie = (key[:, None, :] == key[:, :, None]) & (
-                bpos[None, None, :] <= bpos[None, :, None]
-            )
-            le = (key[:, None, :] < key[:, :, None]) | tie   # (Fc, Bc, Bc)
-            pref = jnp.einsum(
-                "fij,fjv->fiv", le.astype(jnp.float32), stats3,
-                preferred_element_type=jnp.float32,
-            )                                                # (Fc, Bc, 3)
-            cgl, chl, ccl = pref[..., 0], pref[..., 1], pref[..., 2]
-            cgr = tg_c[:, None] - cgl
-            chr_ = th_c[:, None] - chl
-            ccr = tc_c[:, None] - ccl
-            pos = le.sum(-1) - 1                             # sorted position
-            cvalid = (
-                (pos < lim[:, None])
-                & (ccl >= min_data) & (ccr >= min_data)
-                & (chl >= min_hess) & (chr_ >= min_hess)
-                & catf_c[:, None]
-                & leaf_ok_c[:, None]
-            )
-            cgain = jnp.where(
-                cvalid, score(cgl, chl) + score(cgr, chr_) - parent_c[:, None], NEG
-            )
-            ibest = jnp.argmax(cgain, axis=1)                # original bin id
-            return le, ibest, jnp.take_along_axis(cgain, ibest[:, None], 1)[:, 0], pref
-
-        inf = jnp.float32(jnp.inf)
-        key_asc = jnp.where(present, ratio, inf)
-        key_desc = jnp.where(present, -ratio, inf)
-        le1, i1, g1, p1 = one_dir(key_asc)
-        le2, i2, g2, p2 = one_dir(key_desc)
-        use2 = g2 > g1                                      # strict, host parity
-        ci = jnp.where(use2, i2, i1)
-        cbest_gain_c = jnp.maximum(g1, g2)                  # (Fc,)
-        # scatter reduced gains back to full feature space
-        cbest_gain = jnp.full((F,), NEG).at[ci_arr].set(cbest_gain_c)
-
-        # -- combine per feature, then first-argmax over features -----------
-        fgain = jnp.maximum(nbest_gain, cbest_gain)
-        use_cat_f = cbest_gain > nbest_gain
-        f_star = jnp.argmax(fgain)
-        gain = fgain[f_star]
-        is_cat = use_cat_f[f_star] & categorical_arr[f_star]
-        t_star = nbest_t[f_star]
-        # member mask, True = left
-        num_member = jnp.arange(B) <= t_star
-        # f_star's slot in the reduced view (cat_idx is sorted); clamped
-        # garbage when f_star is numeric — masked out by is_cat
-        fpos = jnp.clip(
-            jnp.searchsorted(ci_arr, f_star).astype(jnp.int32), 0, Fc - 1
-        )
-        cif = ci[fpos]
-        cat_member_c = jnp.where(use2[fpos], le2[fpos, cif], le1[fpos, cif])
-        cat_member = jnp.zeros(B, bool).at[:Bc].set(cat_member_c)
-        member = jnp.where(is_cat, cat_member, num_member)
-        # left stats at the chosen cut
-        left_num = jnp.stack([cg[f_star, t_star], ch[f_star, t_star], cc[f_star, t_star]])
-        left_cat = jnp.where(use2[fpos], p2[fpos, cif], p1[fpos, cif])
-        left = jnp.where(is_cat, left_cat, left_num)
-        total = jnp.stack([tg[f_star], th[f_star], tc[f_star]])
-        right = total - left
-        thr_bin = jnp.where(is_cat, -1, t_star).astype(jnp.int32)
-        return gain, f_star.astype(jnp.int32), thr_bin, is_cat, member, left, right
 
     # -- root ----------------------------------------------------------------
     use_pallas = hist_impl == "pallas"
@@ -894,3 +939,88 @@ def walk_trees_raw(x, feats, thresholds, is_cat, cat_masks, lefts, rights,
         feats, thresholds, is_cat, cat_masks, lefts, rights, is_leaf, values
     )
     return outs.T
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_bins", "max_cat_threshold", "n_bins_static", "cat_static",
+    ),
+)
+def best_splits_for_hists(
+    hists,           # (M, F, B, 3) f32 — one histogram per candidate leaf
+    depth_ok,        # traced bool (children of one split share a depth)
+    n_bins_arr,      # (F,) int32
+    categorical_arr, # (F,) bool
+    feature_mask,    # (F,) bool
+    min_data, min_hess, l1, l2,  # traced f32 scalars
+    *,
+    num_bins: int,
+    max_cat_threshold: int,
+    n_bins_static=None,
+    cat_static=None,
+):
+    """Vectorized best_split over M leaf histograms — the streamed grower's
+    split finder. SAME traced arithmetic as the fused grower's per-leaf
+    rule (_best_split_impl), so streamed trees decide splits exactly the
+    way in-memory trees do; only the histogram accumulation order (fixed
+    chunk order vs one whole-n contraction) can differ, in f32 ulps.
+
+    Returns (gain (M,), feat (M,), thr_bin (M,), is_cat (M,),
+    member (M, B), left (M, 3), right (M, 3))."""
+    import jax.numpy as jnp
+
+    def one(h):
+        return _best_split_impl(
+            h, depth_ok, n_bins_arr, categorical_arr, feature_mask,
+            min_data, min_hess, l1, l2,
+            num_bins=num_bins, max_cat_threshold=max_cat_threshold,
+            n_bins_static=n_bins_static, cat_static=cat_static,
+        )
+
+    return jax.vmap(one)(hists.astype(jnp.float32))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_bins", "n_bins_static", "hist_impl"),
+)
+def route_hist_chunk(
+    bins,        # (m, F) uint8/int32 — ONE streamed chunk's binned rows
+    grad,        # (m,) f32
+    hess,        # (m,) f32
+    smask,       # (m,) bool — bagging/sample mask for these rows
+    assign,      # (m,) int32 — current leaf assignment of these rows
+    member,      # (B,) bool — split membership of leaf `slot` (True = left)
+    feat, slot, new_slot, small_slot,  # traced int32 scalars
+    *,
+    num_bins: int,
+    n_bins_static=None,
+    hist_impl: str = "einsum",
+):
+    """One streamed chunk's share of a split step: route the chunk's rows
+    of leaf `slot` through the split (member[bin] False -> `new_slot`) and
+    return the chunk's (F, B, 3) histogram contribution over rows landing
+    in `small_slot` — exactly the per-split routing + small-child histogram
+    of _grow_tree_body, at chunk granularity. The host accumulates these
+    contributions across chunks in FIXED chunk order (deterministic f32
+    sums), so an out-of-core fit is bit-reproducible at a given chunk size.
+
+    The root pass reuses this kernel degenerately: feat=slot=new_slot=
+    small_slot=0 with an all-ones member routes nothing and histograms
+    smask & (assign == 0).
+
+    Returns (new_assign (m,) int32, hist (F, B, 3) f32)."""
+    import jax.numpy as jnp
+
+    bins = bins.astype(jnp.int32)  # uint8 wire format -> device int32 once
+    fcol = jnp.take(bins, feat, axis=1)
+    go_left = member[fcol]
+    new_assign = jnp.where(
+        (assign == slot) & ~go_left, new_slot, assign
+    ).astype(jnp.int32)
+    hist = _hist_masked(
+        bins, grad, hess, smask & (new_assign == small_slot), num_bins,
+        n_bins_static, hist_impl,
+    )
+    return new_assign, hist

@@ -142,6 +142,15 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
         "Checkpoint generations retained per store (older ones are deleted)",
         TypeConverters.to_int,
     )
+    stream_chunk_rows = Param(
+        "stream_chunk_rows",
+        "Out-of-core fit: bin and spill the dataset in chunks of this many "
+        "rows, then stream every histogram pass through the device on a "
+        "fixed footprint (0: off, fit in-memory). Streamed fits are "
+        "deterministic at a given chunk size; rf/dart/goss and "
+        "early stopping are guarded (docs/dataplane.md)",
+        TypeConverters.to_int,
+    )
 
     def _set_shared_defaults(self) -> None:
         self._set_defaults(
@@ -178,6 +187,7 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
             other_rate=0.1,
             checkpoint_every=10,
             checkpoint_keep_last=3,
+            stream_chunk_rows=0,
         )
 
     def _train_config(self, categorical_indexes: List[int]) -> TrainConfig:
@@ -259,6 +269,7 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
             checkpoint_dir=ckpt_dir,
             checkpoint_every=self.get(self.checkpoint_every),
             checkpoint_keep_last=self.get(self.checkpoint_keep_last),
+            stream_chunk_rows=self.get(self.stream_chunk_rows),
         )
 
 

@@ -19,6 +19,7 @@ normalization) | goss (gradient one-side sampling).
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -142,13 +143,50 @@ def train_booster(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 10,
     checkpoint_keep_last: int = 3,
+    stream_chunk_rows: int = 0,
     _resume_state: Optional[Dict[str, Any]] = None,
     _capture_resume_state: bool = False,
+    _stream_data: Optional["_StreamData"] = None,
 ) -> Booster:
     import jax
     import jax.numpy as jnp
 
     from mmlspark_tpu.gbdt.compute import add_leaf_outputs
+
+    if stream_chunk_rows or _stream_data is not None:
+        # Out-of-core fit: the feature matrix is binned and spilled in
+        # bounded chunks, every histogram pass streams chunks through the
+        # device via the double-buffered prefetcher, and per-row state
+        # (raw scores, leaf assignment) is the only O(n) host footprint —
+        # independent of F, so peak RSS is a fraction of the in-memory
+        # path's O(n*F) matrices (docs/dataplane.md "Streaming ingestion").
+        _guard_streaming(cfg, valid_mask, init_raw)
+        if checkpoint_dir:
+            return _train_booster_checkpointed(
+                x, y, objective, cfg,
+                sample_weight=sample_weight, valid_mask=None,
+                init_model=init_model, feature_names=feature_names,
+                init_raw=None, checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                checkpoint_keep_last=checkpoint_keep_last,
+                stream_chunk_rows=stream_chunk_rows,
+                _stream_data=_stream_data,
+            )
+        data = _stream_data
+        own = data is None
+        if own:
+            data = _prepare_stream_from_arrays(
+                x, y, sample_weight, cfg, int(stream_chunk_rows),
+                init_model=init_model,
+            )
+        try:
+            return _train_booster_streamed(
+                data, objective, cfg, init_model, feature_names,
+                _resume_state, _capture_resume_state,
+            )
+        finally:
+            if own:
+                data.cleanup()
 
     if checkpoint_dir:
         # Crash-consistent per-K-rounds checkpointing: the boosting loop is
@@ -369,6 +407,15 @@ def train_booster(
     trees: List[Any] = list(init_model.trees) if init_model is not None else []
     start_iter = len(trees) // k
     bag_mask = train_rows.copy()
+    if _resume_state is not None and _resume_state.get("bag_mask") is not None:
+        # the ACTIVE bagging mask at the previous segment's end: a segment
+        # starting between bagging_freq redraws must keep training on it —
+        # resetting to all-rows here used to silently un-bag those trees
+        # whenever checkpoint_every was not a multiple of bagging_freq
+        bm = np.asarray(_resume_state["bag_mask"], bool)
+        if pad:
+            bm = np.concatenate([bm, np.zeros(pad, bool)])
+        bag_mask = bm & train_rows
     use_bagging = (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0) or rf_mode
 
     # early stopping bookkeeping (shared rule, see _ValidTracker)
@@ -442,7 +489,7 @@ def train_booster(
     if fast_path:
         from mmlspark_tpu.gbdt.compute import boost_loop_fused
 
-        mask_bank = [train_rows]
+        mask_bank = [bag_mask]  # carried segment mask (== train_rows fresh)
         mask_idx: List[int] = []
         fmask_rows: List[np.ndarray] = []
         cur = 0
@@ -559,6 +606,12 @@ def train_booster(
                 "raw": np.asarray(raw)[:n_orig],
                 "rng_state": rng.bit_generator.state,
                 "frng_state": frng.bit_generator.state,
+                # the active bagging mask (see the resume restore above);
+                # None when bagging is off keeps checkpoints O(raw)-sized
+                "bag_mask": (
+                    np.asarray(mask_bank[mask_idx[-1]])[:n_orig]
+                    if use_bagging else None
+                ),
             }
         return booster
 
@@ -706,16 +759,719 @@ def train_booster(
             "raw": np.asarray(raw)[:n_orig],
             "rng_state": rng.bit_generator.state,
             "frng_state": frng.bit_generator.state,
+            "bag_mask": (
+                np.asarray(bag_mask)[:n_orig] if use_bagging else None
+            ),
         }
     return booster
 
 
-def _gbdt_fingerprint(x: np.ndarray, y: np.ndarray, objective: Objective,
+# -- out-of-core streaming (ISSUE 9) ------------------------------------------
+
+
+def _guard_streaming(cfg: TrainConfig, valid_mask, init_raw) -> None:
+    """Streamed fits support plain gbdt boosting; the modes whose global
+    state cannot ride a chunk stream are guarded explicitly (the PR 8
+    checkpoint-guard pattern) rather than silently approximated."""
+    if cfg.boosting_type != "gbdt":
+        raise ValueError(
+            f"stream_chunk_rows supports boosting_type='gbdt', not "
+            f"{cfg.boosting_type!r}: rf averages independent bagged fits, "
+            "dart rescores dropped trees over all rows, and goss ranks "
+            "global gradients — none of which stream chunk-wise; fit "
+            "in-memory or disable streaming"
+        )
+    if cfg.early_stopping_round > 0:
+        raise ValueError(
+            "stream_chunk_rows and early_stopping_round are mutually "
+            "exclusive: streamed fits carry no validation split; disable "
+            "one of them"
+        )
+    if valid_mask is not None:
+        raise ValueError(
+            "stream_chunk_rows does not support a validation split "
+            "(validation_indicator_col); evaluate on a held-out reader "
+            "after the fit instead"
+        )
+    if init_raw is not None:
+        raise ValueError(
+            "stream_chunk_rows does not support init_score_col (per-row "
+            "base margins); fold margins into the label or fit in-memory"
+        )
+
+
+_STREAM_METRICS: Dict[str, Any] = {}
+
+
+def _stream_metrics() -> Dict[str, Any]:
+    if not _STREAM_METRICS:
+        reg = obs_registry()
+        _STREAM_METRICS["spilled"] = reg.counter(
+            "gbdt_stream_spilled_bytes_total",
+            "Binned chunk bytes spilled to disk by streamed GBDT fits")
+        _STREAM_METRICS["visits"] = reg.counter(
+            "gbdt_stream_chunk_visits_total",
+            "Chunk device passes made by streamed GBDT histogram/routing")
+    return _STREAM_METRICS
+
+
+@dataclasses.dataclass
+class _StreamData:
+    """Prepared out-of-core fit state: the binner, the spilled binned
+    chunks (wire dtype on disk), and the per-row vectors — everything a
+    segment needs, built ONCE per fit so checkpoint segments never re-bin
+    or re-spill."""
+
+    n: int
+    f: int
+    y: np.ndarray                      # (n,) float64
+    w: Optional[np.ndarray]            # (n,) float64 or None
+    binner: BinMapper
+    wire: Any                          # spill dtype (uint8 / int32)
+    spill_paths: List[str]
+    offsets: List[Any]                 # per chunk (lo, hi) row window
+    spill_root: Optional[str]          # owned tmp dir (rm on cleanup)
+    chunk_rows: int
+    warm_raw: Optional[np.ndarray] = None  # streamed init_model raw scores
+    bins_sample_sha: Optional[str] = None  # data identity for fingerprints
+
+    def cleanup(self) -> None:
+        if self.spill_root:
+            import shutil
+
+            shutil.rmtree(self.spill_root, ignore_errors=True)
+            self.spill_root = None
+
+
+def _prepare_stream(
+    chunk_factory,                     # () -> fresh iterator of f32 chunks
+    n: int,
+    y: np.ndarray,
+    w: Optional[np.ndarray],
+    cfg: TrainConfig,
+    chunk_rows: int,
+    init_model: Optional[Booster],
+    spill_dir: Optional[str] = None,
+) -> _StreamData:
+    """Two bounded passes over the source: (1) streamed binner fit —
+    bit-identical edges to the in-memory fit via the known-n sample draw
+    (BinMapper.fit_from_chunks); (2) chunked bin transform spilled to disk
+    in the uint8 wire format (4-8x smaller than the source floats), plus
+    the warm-start raw scores when continuing from an init model. Peak
+    host memory is O(chunk + sample_cap*f) — never O(n*f)."""
+    import hashlib
+    import tempfile
+
+    tr = obs_tracer()
+    phase_hist = obs_registry().histogram(
+        "gbdt_phase_seconds", "Wall seconds per GBDT training phase",
+        ("phase",),
+    )
+    t0 = time.perf_counter()
+    binner = BinMapper(cfg.max_bin, cfg.categorical_indexes)
+    with tr.span("gbdt:binning", rows=n, streamed=True):
+        binner.fit_from_chunks(chunk_factory(), total_rows=n)
+    phase_hist.labels(phase="binning").observe(time.perf_counter() - t0)
+
+    f = binner.num_features
+    wire = np.uint8 if binner.max_n_bins <= 256 else np.int32
+    root = tempfile.mkdtemp(prefix="gbdt-stream-", dir=spill_dir)
+    spill_paths: List[str] = []
+    offsets: List[Any] = []
+    warm_parts: List[np.ndarray] = []
+    m = _stream_metrics()
+    t0 = time.perf_counter()
+    with tr.span("gbdt:bin_spill", rows=n, streamed=True):
+        pos = 0
+        for i, chunk in enumerate(chunk_factory()):
+            chunk = np.asarray(chunk, np.float32)
+            rows = chunk.shape[0]
+            buf = np.empty((rows, f), wire)
+            binner.transform(chunk, out=buf)
+            path = os.path.join(root, f"bins_{i:05d}.npy")
+            np.save(path, buf)
+            m["spilled"].inc(buf.nbytes)
+            spill_paths.append(path)
+            offsets.append((pos, pos + rows))
+            pos += rows
+            if init_model is not None:
+                warm_parts.append(
+                    np.asarray(init_model.predict_raw(chunk), np.float32)
+                )
+    phase_hist.labels(phase="bin_spill").observe(time.perf_counter() - t0)
+    if pos != n:
+        raise ValueError(f"stream yielded {pos} rows, expected {n}")
+
+    # data identity for checkpoint fingerprints: 64 evenly spaced binned
+    # rows, read back through npy mmaps (O(rows) however large the spill)
+    h = hashlib.sha256()
+    idx = np.linspace(0, n - 1, min(64, n)).astype(int)
+    by_chunk: Dict[int, List[int]] = {}
+    for gi in idx:
+        ci = next(
+            i for i, (lo, hi) in enumerate(offsets) if lo <= gi < hi
+        )
+        by_chunk.setdefault(ci, []).append(int(gi))
+    for ci in sorted(by_chunk):
+        mm = np.load(spill_paths[ci], mmap_mode="r")
+        lo = offsets[ci][0]
+        rows = np.array([mm[g - lo] for g in by_chunk[ci]])
+        h.update(np.ascontiguousarray(rows).tobytes())
+
+    return _StreamData(
+        n=n, f=f,
+        y=np.asarray(y, np.float64),
+        w=None if w is None else np.asarray(w, np.float64),
+        binner=binner, wire=wire,
+        spill_paths=spill_paths, offsets=offsets, spill_root=root,
+        chunk_rows=int(chunk_rows),
+        warm_raw=(
+            np.concatenate(warm_parts) if warm_parts else None
+        ),
+        bins_sample_sha=h.hexdigest(),
+    )
+
+
+def _prepare_stream_from_arrays(
+    x: np.ndarray,
+    y: np.ndarray,
+    w: Optional[np.ndarray],
+    cfg: TrainConfig,
+    chunk_rows: int,
+    init_model: Optional[Booster] = None,
+    spill_dir: Optional[str] = None,
+) -> _StreamData:
+    """In-memory arrays chunked as zero-copy row views (the
+    stream_chunk_rows estimator path): the caller already holds x, so the
+    win is the bounded DEVICE footprint plus the uint8 spill replacing the
+    binned int32 matrix."""
+    if chunk_rows <= 0:
+        raise ValueError("stream_chunk_rows must be positive")
+    x = np.asarray(x)
+    n = x.shape[0]
+
+    def chunks():
+        for lo in range(0, n, chunk_rows):
+            yield np.asarray(x[lo: lo + chunk_rows], np.float32)
+
+    return _prepare_stream(
+        chunks, n, y, w, cfg, chunk_rows, init_model, spill_dir
+    )
+
+
+def _prepare_stream_from_reader(
+    reader,
+    feature_cols: List[str],
+    label_col: str,
+    weight_col: Optional[str],
+    cfg: TrainConfig,
+    init_model: Optional[Booster] = None,
+    spill_dir: Optional[str] = None,
+) -> _StreamData:
+    """Shard-reader source (io/columnar.py): chunks stream straight from
+    Parquet/npy shards; the label/weight vectors fill during the passes
+    (per-row O(n) state — the documented streaming floor). The reader must
+    be RE-ITERABLE and know num_rows (Parquet footers / npy headers do)."""
+    n = reader.num_rows
+    if n is None:
+        raise ValueError(
+            "streamed GBDT needs reader.num_rows (Parquet footers and npy "
+            "headers provide it); wrap opaque sources in a counting pass "
+            "first"
+        )
+    y = np.empty(n, np.float64)
+    w = np.empty(n, np.float64) if weight_col else None
+
+    def chunks():
+        pos = 0
+        for ch in reader.iter_chunks():
+            y[pos: pos + ch.rows] = np.asarray(
+                ch.columns[label_col], np.float64
+            )
+            if w is not None:
+                w[pos: pos + ch.rows] = np.asarray(
+                    ch.columns[weight_col], np.float64
+                )
+            yield ch.matrix(feature_cols, np.float32)
+            pos += ch.rows
+
+    return _prepare_stream(
+        chunks, n, y, w, cfg, reader.chunk_rows, init_model, spill_dir
+    )
+
+
+def train_booster_from_reader(
+    reader,
+    feature_cols: List[str],
+    objective: Objective,
+    cfg: TrainConfig,
+    label_col: str = "label",
+    weight_col: Optional[str] = None,
+    feature_names: Optional[List[str]] = None,
+    init_model: Optional[Booster] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 10,
+    checkpoint_keep_last: int = 3,
+    spill_dir: Optional[str] = None,
+) -> Booster:
+    """Out-of-core GBDT fit straight from a ShardReader (io/columnar.py):
+    the feature matrix never materializes on host — chunks are binned and
+    spilled in the wire format, then every histogram pass streams them
+    through the device behind the double-buffered prefetcher. Composes
+    with PR 8 checkpointing (checkpoint_dir): a killed fit resumes from
+    the last good generation and regrows identical trees at the same
+    chunk size."""
+    _guard_streaming(cfg, None, None)
+    data = _prepare_stream_from_reader(
+        reader, list(feature_cols), label_col, weight_col, cfg,
+        init_model=init_model, spill_dir=spill_dir,
+    )
+    try:
+        if checkpoint_dir:
+            return _train_booster_checkpointed(
+                None, data.y, objective, cfg,
+                sample_weight=data.w, valid_mask=None,
+                init_model=init_model, feature_names=feature_names,
+                init_raw=None, checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                checkpoint_keep_last=checkpoint_keep_last,
+                stream_chunk_rows=data.chunk_rows,
+                _stream_data=data,
+            )
+        return _train_booster_streamed(
+            data, objective, cfg, init_model, feature_names, None, False
+        )
+    finally:
+        data.cleanup()
+
+
+def _train_booster_streamed(
+    data: _StreamData,
+    objective: Objective,
+    cfg: TrainConfig,
+    init_model: Optional[Booster],
+    feature_names: Optional[List[str]],
+    _resume_state: Optional[Dict[str, Any]],
+    _capture_resume_state: bool,
+) -> Booster:
+    """The streamed boosting loop: per-row state (raw scores, gradients,
+    leaf assignment) lives on host — O(n) scalars, independent of F — and
+    the O(n*F) binned matrix streams from the spill per histogram pass.
+    Each split step makes ONE bounded pass: chunks ride the double-buffered
+    prefetcher, the route_hist_chunk kernel routes rows and returns the
+    chunk's small-child histogram, and contributions accumulate in FIXED
+    chunk order (deterministic f32 sums — reruns at the same chunk size are
+    bit-identical). Split decisions run the SAME device split rule as the
+    fused in-memory grower (compute.best_splits_for_hists), so streamed
+    trees match in-memory trees except where chunk-order f32 accumulation
+    flips a near-tie."""
+    import jax
+
+    from mmlspark_tpu.gbdt.compute import best_splits_for_hists
+
+    log = get_logger("mmlspark_tpu.gbdt")
+    n, f = data.n, data.f
+    k = objective.num_model_per_iter
+    y, w = data.y, data.w
+    if hasattr(objective, "prepare"):
+        objective.prepare(y, w)
+
+    tr = obs_tracer()
+    phase_hist = obs_registry().histogram(
+        "gbdt_phase_seconds", "Wall seconds per GBDT training phase",
+        ("phase",),
+    )
+    binner = data.binner
+    num_bins = binner.max_n_bins
+    categorical = [binner.is_categorical(j) for j in range(f)]
+    n_bins_static = tuple(int(b) for b in binner.n_bins)
+    cat_static = tuple(bool(c) for c in categorical)
+    n_bins_arr = np.asarray(binner.n_bins, np.int32)
+    cat_arr = np.asarray(categorical, bool)
+    scalars = dict(
+        min_data=np.float32(cfg.min_data_in_leaf),
+        min_hess=np.float32(cfg.min_sum_hessian_in_leaf),
+        l1=np.float32(cfg.lambda_l1),
+        l2=np.float32(cfg.lambda_l2),
+    )
+    depth_limit = (
+        int(cfg.max_depth) if cfg.max_depth > 0 else cfg.num_leaves
+    )
+    grow_cfg = GrowConfig(
+        num_leaves=cfg.num_leaves,
+        max_depth=cfg.max_depth,
+        min_data_in_leaf=cfg.min_data_in_leaf,
+        min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+        lambda_l1=cfg.lambda_l1,
+        lambda_l2=cfg.lambda_l2,
+        min_gain_to_split=cfg.min_gain_to_split,
+        learning_rate=cfg.learning_rate,
+    )
+
+    y32 = np.asarray(y, np.float32)
+    w32 = None if w is None else np.asarray(w, np.float32)
+
+    # -- raw-score init (mirrors the in-memory path minus padding) ----------
+    if _resume_state is not None and _resume_state.get("raw") is not None:
+        raw = np.array(_resume_state["raw"], np.float32)
+        init_score = (
+            init_model.init_score if init_model is not None
+            else np.zeros(k, np.float64)
+        )
+    elif init_model is not None:
+        if data.warm_raw is None:
+            raise ValueError(
+                "streamed warm start needs the init model at prepare time "
+                "(pass init_model to the same call that streams the data)"
+            )
+        raw = np.array(data.warm_raw, np.float32)
+        if k > 1 and raw.ndim == 1:
+            raw = np.repeat(raw[:, None], k, axis=1)
+        init_score = init_model.init_score
+    else:
+        init_score = objective.init_score(y, w)
+        raw = np.zeros((n, k) if k > 1 else (n,), np.float32) + (
+            init_score[None, :] if k > 1 else np.float32(init_score[0])
+        )
+
+    # chunked gradients: elementwise (or row-wise softmax) objectives give
+    # bit-identical values chunk-wise vs whole-array
+    if w is None:
+        grad_fn = jax.jit(lambda r, yy: objective.grad_hess(r, yy, None))
+    else:
+        grad_fn = jax.jit(objective.grad_hess)
+
+    rng = np.random.default_rng(cfg.bagging_seed)
+    frng = np.random.default_rng(cfg.bagging_seed + 17)
+    if _resume_state is not None:
+        if _resume_state.get("rng_state") is not None:
+            rng.bit_generator.state = _resume_state["rng_state"]
+        if _resume_state.get("frng_state") is not None:
+            frng.bit_generator.state = _resume_state["frng_state"]
+
+    # the in-memory bag_draw, unpadded: draws consume the 1024-quantized
+    # n_base so streamed and in-memory fits see identical mask sequences
+    n_base = n + ((-n) % 1024)
+
+    def bag_draw() -> np.ndarray:
+        return rng.random(n_base)[:n]
+
+    trees: List[Any] = list(init_model.trees) if init_model is not None else []
+    start_iter = len(trees) // k
+    use_bagging = cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
+    bag_mask = np.ones(n, bool)
+    if _resume_state is not None and _resume_state.get("bag_mask") is not None:
+        # carry the previous segment's ACTIVE bagging mask: a segment
+        # starting between bagging_freq redraws must keep training on it
+        bag_mask = np.asarray(_resume_state["bag_mask"], bool).copy()
+    assign = np.zeros(n, np.int32)
+    counts = np.zeros((len(data.offsets), cfg.num_leaves), np.int64)
+
+    t_boost = time.perf_counter()
+    boost_span = tr.start_span(
+        "gbdt:boost_streamed",
+        attrs={"iterations": cfg.num_iterations, "rows": n, "features": f,
+               "num_class": k, "chunks": len(data.offsets),
+               "chunk_rows": data.chunk_rows},
+    )
+    try:
+        for it in range(start_iter, start_iter + cfg.num_iterations):
+            if use_bagging and it % max(1, cfg.bagging_freq) == 0:
+                bag_mask = bag_draw() < cfg.bagging_fraction
+            if cfg.feature_fraction < 1.0:
+                n_keep = max(1, int(np.ceil(cfg.feature_fraction * f)))
+                keep = frng.choice(f, size=n_keep, replace=False)
+                fmask = np.zeros(f, bool)
+                fmask[keep] = True
+            else:
+                fmask = np.ones(f, bool)
+
+            g = np.empty_like(raw)
+            h = np.empty_like(raw)
+            for lo, hi in data.offsets:
+                if w is None:
+                    gg, hh = grad_fn(raw[lo:hi], y32[lo:hi])
+                else:
+                    gg, hh = grad_fn(raw[lo:hi], y32[lo:hi], w32[lo:hi])
+                g[lo:hi] = np.asarray(gg)
+                h[lo:hi] = np.asarray(hh)
+
+            for c in range(k):
+                gc = np.ascontiguousarray(g[:, c]) if k > 1 else g
+                hc = np.ascontiguousarray(h[:, c]) if k > 1 else h
+                tree, leaf_vals = _stream_grow_tree(
+                    data, gc, hc, bag_mask, assign, counts,
+                    n_bins_arr, cat_arr, fmask, scalars,
+                    num_bins, cfg.num_leaves, depth_limit,
+                    int(grow_cfg.max_cat_threshold),
+                    n_bins_static, cat_static,
+                    np.float32(cfg.learning_rate), grow_cfg, binner,
+                )
+                trees.append(tree)
+                if k > 1:
+                    raw[:, c] += leaf_vals[assign]
+                else:
+                    raw += leaf_vals[assign]
+            if cfg.verbosity > 0 and (it % 10 == 0):
+                log.info("streamed iter %d (%d trees)", it, len(trees))
+    finally:
+        tr.end_span(boost_span)
+        phase_hist.labels(phase="boost_streamed").observe(
+            time.perf_counter() - t_boost
+        )
+
+    booster = Booster(
+        trees,
+        objective.kind,
+        num_class=getattr(objective, "num_class", 1),
+        init_score=np.atleast_1d(init_score),
+        feature_names=feature_names,
+        num_features=f,
+        avg_output=False,
+        objective_params=_objective_params(objective),
+    )
+    if _capture_resume_state:
+        booster._resume_capture = {
+            "raw": raw.copy(),
+            "rng_state": rng.bit_generator.state,
+            "frng_state": frng.bit_generator.state,
+            "bag_mask": bag_mask.copy() if use_bagging else None,
+        }
+    return booster
+
+
+def _leaf_out_f32(g, h, l1: np.float32, l2: np.float32):
+    """The device grower's f32 leaf output, replicated in numpy f32
+    (identical IEEE ops, so streamed and fused leaf values agree given
+    identical stats)."""
+    g = np.float32(g)
+    t = np.sign(g) * np.maximum(np.abs(g) - l1, np.float32(0.0))
+    return -t / np.maximum(np.float32(h) + l2, np.float32(1e-35))
+
+
+def _stream_grow_tree(
+    data: _StreamData,
+    g: np.ndarray,
+    h: np.ndarray,
+    bag_mask: np.ndarray,
+    assign: np.ndarray,
+    counts: np.ndarray,
+    n_bins_arr: np.ndarray,
+    cat_arr: np.ndarray,
+    fmask: np.ndarray,
+    scalars: Dict[str, np.float32],
+    num_bins: int,
+    num_leaves: int,
+    depth_limit: int,
+    max_cat_threshold: int,
+    n_bins_static,
+    cat_static,
+    learning_rate: np.float32,
+    grow_cfg: GrowConfig,
+    binner: BinMapper,
+):
+    """Grow ONE leaf-wise tree with streamed histogram passes.
+
+    Host bookkeeping mirrors _grow_tree_body's device state slot for slot
+    (same packed finalize layout, decoded by the same unpack_tree); every
+    histogram comes from a bounded chunk pass through route_hist_chunk with
+    contributions summed in fixed chunk order. Chunks with no rows in the
+    split leaf are skipped — adding their all-zero histograms would change
+    nothing, so the skip is numerics-exact, and late splits touch only the
+    few chunks whose rows actually reach them.
+    """
+    from mmlspark_tpu.core.prefetch import DeviceChunkPrefetcher
+    from mmlspark_tpu.gbdt.compute import (
+        best_splits_for_hists,
+        route_hist_chunk,
+    )
+
+    L, B, F = num_leaves, num_bins, data.f
+    NEG = np.float32(-np.inf)
+    offsets, spill = data.offsets, data.spill_paths
+    n_chunks = len(offsets)
+    assign[:] = 0
+    counts[:] = 0
+    for ci, (lo, hi) in enumerate(offsets):
+        counts[ci, 0] = hi - lo
+    visits = _stream_metrics()["visits"]
+
+    def chunk_pass(ids, member, feat, slot, new_slot, small_slot,
+                   route: bool):
+        """Stream the listed chunks through the device once; returns the
+        (F, B, 3) histogram summed in FIXED chunk order. `route` stores
+        the updated leaf assignment and per-chunk leaf counts back."""
+        acc = np.zeros((F, B, 3), np.float32)
+        ids = list(ids)
+
+        def stage(ci):
+            lo, hi = offsets[ci]
+            return {
+                "bins": np.load(spill[ci]),
+                "g": g[lo:hi], "h": h[lo:hi],
+                "mask": bag_mask[lo:hi], "assign": assign[lo:hi],
+            }
+
+        with DeviceChunkPrefetcher(iter(ids), stage, depth=2) as pf:
+            for pos, dev in enumerate(pf):
+                ci = ids[pos]
+                na, hist_c = route_hist_chunk(
+                    dev["bins"], dev["g"], dev["h"], dev["mask"],
+                    dev["assign"], member,
+                    np.int32(feat), np.int32(slot), np.int32(new_slot),
+                    np.int32(small_slot),
+                    num_bins=B, n_bins_static=n_bins_static,
+                    hist_impl="einsum",
+                )
+                if route:
+                    lo, hi = offsets[ci]
+                    na_h = np.asarray(na)
+                    assign[lo:hi] = na_h
+                    counts[ci, slot] = int((na_h == slot).sum())
+                    counts[ci, new_slot] = int((na_h == new_slot).sum())
+                acc += np.asarray(hist_c)
+                visits.inc()
+        return acc
+
+    def find_splits(hists, depth_ok):
+        out = best_splits_for_hists(
+            np.asarray(hists, np.float32), bool(depth_ok),
+            n_bins_arr, cat_arr, fmask,
+            scalars["min_data"], scalars["min_hess"],
+            scalars["l1"], scalars["l2"],
+            num_bins=B, max_cat_threshold=max_cat_threshold,
+            n_bins_static=n_bins_static, cat_static=cat_static,
+        )
+        return [np.asarray(a) for a in out]
+
+    # -- root ---------------------------------------------------------------
+    hist0 = chunk_pass(range(n_chunks), np.ones(B, bool), 0, 0, 0, 0,
+                       route=False)
+    hists = np.zeros((L, F, B, 3), np.float32)
+    hists[0] = hist0
+    stats = np.zeros((L, 3), np.float32)
+    stats[0] = [hist0[0, :, 0].sum(), hist0[0, :, 1].sum(),
+                hist0[0, :, 2].sum()]
+    depths = np.zeros(L, np.int32)
+    bg, bf, bt, bic, bm, bl, br = find_splits(hist0[None], 0 < depth_limit)
+    best_gain = np.full(L, NEG, np.float32)
+    best_feat = np.zeros(L, np.int32)
+    best_bin = np.zeros(L, np.int32)
+    best_is_cat = np.zeros(L, bool)
+    best_member = np.zeros((L, B), bool)
+    best_left = np.zeros((L, 3), np.float32)
+    best_right = np.zeros((L, 3), np.float32)
+    best_gain[0], best_feat[0], best_bin[0] = bg[0], bf[0], bt[0]
+    best_is_cat[0], best_member[0] = bic[0], bm[0]
+    best_left[0], best_right[0] = bl[0], br[0]
+
+    node_feat = np.zeros(L, np.int32)
+    node_bin = np.zeros(L, np.int32)
+    node_is_cat = np.zeros(L, bool)
+    node_gain = np.zeros(L, np.float32)
+    node_value = np.zeros(L, np.float32)
+    node_count = np.zeros(L, np.int64)
+    node_left = np.full(L, -(2 ** 30), np.int64)
+    node_right = np.full(L, -(2 ** 30), np.int64)
+    node_member = np.zeros((L, B), bool)
+    slot_parent = np.full(L, -1, np.int64)
+    slot_side = np.zeros(L, np.int64)
+    n_leaves, n_nodes = 1, 0
+    gain_floor = np.float32(max(grow_cfg.min_gain_to_split, 0.0))
+
+    for _step in range(L - 1):
+        s = int(np.argmax(best_gain))
+        if not best_gain[s] > gain_floor:
+            break
+        node_id, new_slot = n_nodes, n_leaves
+        node_feat[node_id] = best_feat[s]
+        node_bin[node_id] = best_bin[s]
+        node_is_cat[node_id] = best_is_cat[s]
+        node_gain[node_id] = best_gain[s]
+        node_value[node_id] = _leaf_out_f32(
+            stats[s, 0], stats[s, 1], scalars["l1"], scalars["l2"]
+        )
+        node_count[node_id] = int(np.float32(stats[s, 2]))
+        node_member[node_id] = best_member[s]
+        p, side = slot_parent[s], slot_side[s]
+        if p >= 0:
+            (node_left if side == 0 else node_right)[p] = node_id
+        slot_parent[s] = slot_parent[new_slot] = node_id
+        slot_side[s], slot_side[new_slot] = 0, 1
+
+        small_is_left = best_left[s, 2] <= best_right[s, 2]
+        small_slot = s if small_is_left else new_slot
+        ids = [ci for ci in range(n_chunks) if counts[ci, s] > 0]
+        small_hist = chunk_pass(
+            ids, best_member[s], int(best_feat[s]), s, new_slot,
+            int(small_slot), route=True,
+        )
+        big_hist = hists[s] - small_hist
+        left_hist = small_hist if small_is_left else big_hist
+        right_hist = big_hist if small_is_left else small_hist
+        hists[s], hists[new_slot] = left_hist, right_hist
+        stats[s], stats[new_slot] = best_left[s], best_right[s]
+        depth = depths[s] + 1
+        depths[s] = depths[new_slot] = depth
+
+        cg_, cf_, ct_, cic_, cm_, cl_, cr_ = find_splits(
+            np.stack([left_hist, right_hist]), depth < depth_limit
+        )
+        for slot_i, out_i in ((s, 0), (new_slot, 1)):
+            best_gain[slot_i] = cg_[out_i]
+            best_feat[slot_i] = cf_[out_i]
+            best_bin[slot_i] = ct_[out_i]
+            best_is_cat[slot_i] = cic_[out_i]
+            best_member[slot_i] = cm_[out_i]
+            best_left[slot_i] = cl_[out_i]
+            best_right[slot_i] = cr_[out_i]
+        n_leaves += 1
+        n_nodes += 1
+
+    # -- finalize: the same packed f32 layout the fused grower emits --------
+    slots = np.arange(L)
+    live = slots < n_leaves
+    leaf_values = np.where(
+        live,
+        _leaf_out_f32(stats[:, 0], stats[:, 1], scalars["l1"],
+                      scalars["l2"]) * learning_rate,
+        np.float32(0.0),
+    ).astype(np.float32)
+    leaf_counts = np.where(live, stats[:, 2], 0.0)
+    node_left_f = node_left.copy()
+    node_right_f = node_right.copy()
+    for slot in range(n_leaves):
+        p = slot_parent[slot]
+        if p >= 0:
+            (node_left_f if slot_side[slot] == 0 else node_right_f)[p] = \
+                ~slot
+    packed = np.concatenate([
+        np.asarray([n_nodes, n_leaves], np.float32),
+        node_feat.astype(np.float32),
+        node_bin.astype(np.float32),
+        node_is_cat.astype(np.float32),
+        node_gain,
+        node_value,
+        node_count.astype(np.float32),
+        node_left_f.astype(np.float32),
+        node_right_f.astype(np.float32),
+        node_member.astype(np.float32).reshape(-1),
+        leaf_values,
+        leaf_counts.astype(np.float32),
+    ])
+    tree = unpack_tree(packed, L, B, binner.threshold_value, grow_cfg)
+    return tree, leaf_values
+
+
+def _gbdt_fingerprint(x: Optional[np.ndarray], y: np.ndarray,
+                      objective: Objective,
                       cfg: TrainConfig,
                       sample_weight: Optional[np.ndarray],
                       valid_mask: Optional[np.ndarray],
                       init_model: Optional[Booster],
-                      init_raw: Optional[np.ndarray]) -> str:
+                      init_raw: Optional[np.ndarray],
+                      stream_chunk_rows: int = 0,
+                      stream_bins_sha: Optional[str] = None) -> str:
     """Identity of (config, data, weights, validation split, objective,
     warm-start inputs) a GBDT checkpoint may resume against. Data is
     sampled (64 rows) — cheap at 100M rows, still collision-proof against
@@ -733,10 +1489,21 @@ def _gbdt_fingerprint(x: np.ndarray, y: np.ndarray, objective: Objective,
     ident["categorical_indexes"] = list(ident["categorical_indexes"])
     ident["objective"] = objective.kind
     ident["num_class"] = getattr(objective, "num_class", 1)
-    ident["n"] = int(x.shape[0])
-    ident["f"] = int(x.shape[1])
+    ident["n"] = int(y.shape[0] if x is None else x.shape[0])
+    if x is not None:
+        ident["f"] = int(x.shape[1])
     ident["has_weight"] = sample_weight is not None
     ident["has_valid"] = valid_mask is not None
+    # streaming keys enter the ident only when streaming is on, so plain
+    # fits' fingerprints stay byte-identical to pre-streaming stores; a
+    # checkpoint is bit-reproducible only at its own chunk size, so the
+    # chunk size is part of the resume identity
+    if stream_chunk_rows:
+        ident["stream_chunk_rows"] = int(stream_chunk_rows)
+    if stream_bins_sha is not None:
+        # reader-sourced fits have no x matrix to sample; the spilled-bin
+        # row sample hashes the data identity instead
+        ident["stream_bins_sha"] = stream_bins_sha
     # warm-start keys enter the ident only when present: a plain fit's
     # fingerprint stays byte-identical to stores written before these
     # inputs were covered, so existing checkpoints keep resuming — while
@@ -748,7 +1515,7 @@ def _gbdt_fingerprint(x: np.ndarray, y: np.ndarray, objective: Objective,
             init_model.model_to_string().encode()).hexdigest()
     return fingerprint(
         ident,
-        (x, np.float64),
+        None if x is None else (x, np.float64),
         (y, np.float64),
         None if sample_weight is None else (sample_weight, np.float64),
         None if valid_mask is None else (valid_mask, bool),
@@ -757,7 +1524,7 @@ def _gbdt_fingerprint(x: np.ndarray, y: np.ndarray, objective: Objective,
 
 
 def _train_booster_checkpointed(
-    x: np.ndarray,
+    x: Optional[np.ndarray],
     y: np.ndarray,
     objective: Objective,
     cfg: TrainConfig,
@@ -769,12 +1536,19 @@ def _train_booster_checkpointed(
     checkpoint_dir: str,
     checkpoint_every: int,
     checkpoint_keep_last: int,
+    stream_chunk_rows: int = 0,
+    _stream_data: Optional[_StreamData] = None,
 ) -> Booster:
     """Boosting driven in `checkpoint_every`-iteration segments, each
     committing to a crash-consistent CheckpointStore; a resumed fit grows
     bit-identical trees to an uninterrupted one (the raw scores and rng
     states cross segments exactly — this is also the seed of incremental
     GBDT refresh: warm-start boosting on the committed ensemble state).
+
+    With `stream_chunk_rows` the segments run the out-of-core streamed
+    engine over ONE shared prepared spill (binned once, never re-binned
+    per segment); the fingerprint then also carries the chunk size, since
+    streamed fits are bit-reproducible only at their own chunk size.
     """
     import json
 
@@ -805,76 +1579,122 @@ def _train_booster_checkpointed(
 
     log = get_logger("mmlspark_tpu.gbdt")
     store = CheckpointStore(checkpoint_dir, keep_last=checkpoint_keep_last)
-    fingerprint = _gbdt_fingerprint(x, y, objective, cfg, sample_weight,
-                                    valid_mask, init_model, init_raw)
 
-    booster = init_model
-    resume: Optional[Dict[str, Any]] = None
-    done = 0
-    ck = store.load_latest()
-    if ck is not None:
-        if ck.meta.get("fingerprint") != fingerprint:
-            raise ValueError(
-                f"checkpoint store {checkpoint_dir!r} was written by a "
-                "different GBDT/data configuration (fingerprint mismatch). "
-                "Pass a fresh checkpoint_dir, delete the stale store, or "
-                "restore the original configuration to resume it."
+    # streamed segments share ONE prepared spill — binned/spilled exactly
+    # once per process however many segments run over it
+    data = _stream_data
+    own_data = stream_chunk_rows and data is None
+    if own_data:
+        data = _prepare_stream_from_arrays(
+            x, y, sample_weight, cfg, int(stream_chunk_rows),
+            init_model=init_model,
+        )
+    if data is not None and not stream_chunk_rows:
+        stream_chunk_rows = data.chunk_rows  # chunk size IS the identity
+    fingerprint = _gbdt_fingerprint(
+        x, y, objective, cfg, sample_weight, valid_mask, init_model,
+        init_raw, stream_chunk_rows=stream_chunk_rows,
+        stream_bins_sha=(data.bins_sample_sha
+                         if x is None and data is not None else None),
+    )
+
+    try:
+        booster = init_model
+        resume: Optional[Dict[str, Any]] = None
+        done = 0
+        ck = store.load_latest()
+        if ck is not None:
+            if ck.meta.get("fingerprint") != fingerprint:
+                raise ValueError(
+                    f"checkpoint store {checkpoint_dir!r} was written by a "
+                    "different GBDT/data configuration (fingerprint "
+                    "mismatch). Pass a fresh checkpoint_dir, delete the "
+                    "stale store, or restore the original configuration to "
+                    "resume it."
+                )
+            booster = Booster.from_string(ck.text("model.txt"))
+            state = ck.json("state.json")
+            arrays = ck.arrays("raw.npz")
+            resume = {
+                "raw": arrays["raw"],
+                "rng_state": state["rng_state"],
+                "frng_state": state["frng_state"],
+                # absent in pre-PR9 stores (and in bagging-off fits): the
+                # engines then fall back to the all-rows mask as before
+                "bag_mask": (
+                    arrays["bag_mask"] if "bag_mask" in arrays else None
+                ),
+            }
+            done = int(ck.meta["iters_done"])
+            log.info(
+                "resuming boosting from checkpoint generation %d "
+                "(%d/%d iterations done)",
+                ck.generation, done, cfg.num_iterations,
             )
-        booster = Booster.from_string(ck.text("model.txt"))
-        state = ck.json("state.json")
-        resume = {
-            "raw": ck.arrays("raw.npz")["raw"],
-            "rng_state": state["rng_state"],
-            "frng_state": state["frng_state"],
-        }
-        done = int(ck.meta["iters_done"])
-        log.info(
-            "resuming boosting from checkpoint generation %d "
-            "(%d/%d iterations done)",
-            ck.generation, done, cfg.num_iterations,
-        )
 
-    while done < cfg.num_iterations:
-        seg = min(checkpoint_every, cfg.num_iterations - done)
-        seg_cfg = dataclasses.replace(cfg, num_iterations=seg)
-        booster = train_booster(
-            x, y, objective, seg_cfg,
-            sample_weight=sample_weight, valid_mask=valid_mask,
-            init_model=booster, feature_names=feature_names,
-            # per-row base margins fold into `raw` in the first segment and
-            # ride the checkpointed raw from then on
-            init_raw=init_raw if (done == 0 and resume is None) else None,
-            _resume_state=resume,
-            _capture_resume_state=True,
-        )
-        done += seg
-        resume = booster._resume_capture
-        store.save(
-            {
-                "model.txt": booster.model_to_string().encode("utf-8"),
-                "raw.npz": pack_arrays({"raw": resume["raw"]}),
-                "state.json": json.dumps({
-                    "rng_state": resume["rng_state"],
-                    "frng_state": resume["frng_state"],
-                }).encode("utf-8"),
-            },
-            meta={"iters_done": done, "fingerprint": fingerprint},
-        )
+        while done < cfg.num_iterations:
+            seg = min(checkpoint_every, cfg.num_iterations - done)
+            seg_cfg = dataclasses.replace(cfg, num_iterations=seg)
+            if data is not None:
+                booster = _train_booster_streamed(
+                    data, objective, seg_cfg, booster, feature_names,
+                    resume, True,
+                )
+            else:
+                booster = train_booster(
+                    x, y, objective, seg_cfg,
+                    sample_weight=sample_weight, valid_mask=valid_mask,
+                    init_model=booster, feature_names=feature_names,
+                    # per-row base margins fold into `raw` in the first
+                    # segment and ride the checkpointed raw from then on
+                    init_raw=(
+                        init_raw if (done == 0 and resume is None) else None
+                    ),
+                    _resume_state=resume,
+                    _capture_resume_state=True,
+                )
+            done += seg
+            resume = booster._resume_capture
+            arrs = {"raw": resume["raw"]}
+            if resume.get("bag_mask") is not None:
+                arrs["bag_mask"] = resume["bag_mask"]
+            store.save(
+                {
+                    "model.txt": booster.model_to_string().encode("utf-8"),
+                    "raw.npz": pack_arrays(arrs),
+                    "state.json": json.dumps({
+                        "rng_state": resume["rng_state"],
+                        "frng_state": resume["frng_state"],
+                    }).encode("utf-8"),
+                },
+                meta={"iters_done": done, "fingerprint": fingerprint},
+            )
 
-    if booster is None:  # num_iterations <= 0 and nothing to resume
-        return train_booster(
-            x, y, objective, cfg,
-            sample_weight=sample_weight, valid_mask=valid_mask,
-            init_model=init_model, feature_names=feature_names,
-            init_raw=init_raw,
-        )
-    # the capture exists only to cross segment boundaries: returning it
-    # would pin a per-row float32 raw array for the model's lifetime
-    if hasattr(booster, "_resume_capture"):
-        del booster._resume_capture
-    # a fully-resumed fit (done >= target at load) returns the committed
-    # ensemble as-is
-    return booster
+        if booster is None:  # num_iterations <= 0 and nothing to resume
+            if data is not None:
+                # streamed degenerate fit: the engine with zero iterations
+                # returns the (empty or warm-start) ensemble — the
+                # in-memory fallback below has no x on the reader path
+                return _train_booster_streamed(
+                    data, objective, cfg, init_model, feature_names,
+                    None, False,
+                )
+            return train_booster(
+                x, y, objective, cfg,
+                sample_weight=sample_weight, valid_mask=valid_mask,
+                init_model=init_model, feature_names=feature_names,
+                init_raw=init_raw,
+            )
+        # the capture exists only to cross segment boundaries: returning it
+        # would pin a per-row float32 raw array for the model's lifetime
+        if hasattr(booster, "_resume_capture"):
+            del booster._resume_capture
+        # a fully-resumed fit (done >= target at load) returns the committed
+        # ensemble as-is
+        return booster
+    finally:
+        if own_data:
+            data.cleanup()
 
 
 def _objective_params(obj: Objective) -> Dict[str, Any]:

@@ -254,15 +254,12 @@ def image_row_arrays(values: Sequence[Any]) -> Optional[list]:
 
 def upload_batch(host_batch: np.ndarray, sharding: Any = None):
     """Counted host->HBM upload of a staged uint8/float batch — the one
-    pipeline-entry transfer of a fused image chain."""
-    import jax
+    pipeline-entry transfer of a fused image chain. Delegates to the
+    generic dataplane upload (core/prefetch.upload_host_chunk) so image and
+    columnar chunks share one counted transfer point."""
+    from mmlspark_tpu.core.prefetch import upload_host_chunk
 
-    dataplane_counters().record_h2d(host_batch.nbytes)
-    return (
-        jax.device_put(host_batch)
-        if sharding is None
-        else jax.device_put(host_batch, sharding)
-    )
+    return upload_host_chunk(host_batch, sharding)
 
 
 def prep_image_batch(

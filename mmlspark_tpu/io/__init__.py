@@ -8,6 +8,17 @@ DataFrames.
 """
 
 from mmlspark_tpu.io.binary import read_binary
+from mmlspark_tpu.io.columnar import (
+    ArrayReader,
+    ColumnarSource,
+    ColumnChunk,
+    NumpyShardReader,
+    ParquetShardReader,
+    ShardReader,
+    open_shards,
+    write_numpy_shards,
+    write_parquet_shards,
+)
 from mmlspark_tpu.io.checkpoint import (
     Checkpoint,
     CheckpointStore,
@@ -23,6 +34,15 @@ from mmlspark_tpu.io.storage_faults import InjectedCrash, StorageFaultInjector
 __all__ = [
     "read_binary",
     "read_images",
+    "ArrayReader",
+    "ColumnChunk",
+    "ColumnarSource",
+    "NumpyShardReader",
+    "ParquetShardReader",
+    "ShardReader",
+    "open_shards",
+    "write_numpy_shards",
+    "write_parquet_shards",
     "Checkpoint",
     "CheckpointStore",
     "CorruptArtifactError",

@@ -1,0 +1,52 @@
+"""Seeded violations for the full-materialize-in-stream-path rule.
+
+Parsed, never imported (tests/test_static_analysis.py). Each flagged line
+carries an `# expect[...]` marker; suppressed lines carry
+`# expect-suppressed[...]`."""
+
+import numpy as np
+import pyarrow.parquet as pq
+
+
+def whole_table_read(path):
+    table = pq.read_table(path)  # expect[full-materialize-in-stream-path]
+    return table
+
+
+def whole_file_read_all(pf):
+    table = pf.read_all()  # expect[full-materialize-in-stream-path]
+    return table
+
+
+def tainted_conversion(path):
+    table = pq.read_table(path)  # expect[full-materialize-in-stream-path]
+    col = table.column("x")
+    arr = col.to_numpy()  # expect[full-materialize-in-stream-path]
+    also = np.asarray(table)  # expect[full-materialize-in-stream-path]
+    return arr, also
+
+
+def tainted_through_alias(pf):
+    t = pf.read_all()  # expect[full-materialize-in-stream-path]
+    u = t
+    return np.concatenate([u["x"]])  # expect[full-materialize-in-stream-path]
+
+
+def combine_chunks_materializes(table):
+    flat = table.combine_chunks()  # expect[full-materialize-in-stream-path]
+    return flat
+
+
+def suppressed_small_data_path(path):
+    # a documented materialize-on-purpose path takes the line suppression
+    table = pq.read_table(path)  # graftcheck: ignore[full-materialize-in-stream-path]  # expect-suppressed[full-materialize-in-stream-path]
+    return table
+
+
+def clean_bounded_chunks(pf):
+    # the idiom the rule exists to protect: per-batch conversion of
+    # bounded RecordBatches is NOT a finding
+    out = []
+    for batch in pf.iter_batches(batch_size=4096):
+        out.append(batch.column(0).to_numpy(zero_copy_only=False))
+    return np.concatenate(out)

@@ -748,6 +748,8 @@ EXEMPT = {
         "needs a live HTTP endpoint; covered by tests/test_longtail.py",
     "mmlspark_tpu.io.cognitive.BingImageSearch":
         "needs a live HTTP endpoint; covered by tests/test_longtail.py",
+    "mmlspark_tpu.io.columnar.ColumnarSource":
+        "reads shard files from disk; covered by tests/test_streaming.py",
 }
 
 # Model classes whose estimator runs in the sweep: the fit() in the sweep IS

@@ -144,6 +144,29 @@ def test_host_sync_rule_ignores_non_transform_functions():
 # -- batch loop ---------------------------------------------------------------
 
 
+def test_full_materialize_in_stream_path_fires_and_suppresses():
+    from mmlspark_tpu.analysis.full_materialize import check_full_materialize
+
+    path = os.path.join(FIXTURES, "stream_bad.py")
+    findings = check_full_materialize([path], repo_root=FIXTURES)
+    _assert_matches_markers("stream_bad.py", findings)
+
+
+def test_full_materialize_allows_bounded_chunk_conversion():
+    from mmlspark_tpu.analysis.full_materialize import check_full_materialize
+
+    path = os.path.join(FIXTURES, "stream_bad.py")
+    findings = check_full_materialize([path], repo_root=FIXTURES)
+    # per-batch to_numpy on iter_batches RecordBatches (the streaming
+    # idiom, clean_bounded_chunks) must never fire
+    with open(path) as f:
+        clean_line = next(
+            i for i, line in enumerate(f, start=1)
+            if "def clean_bounded_chunks" in line
+        )
+    assert all(f.line < clean_line for f in findings), findings
+
+
 def test_host_roundtrip_in_batch_loop_fires_and_suppresses():
     from mmlspark_tpu.analysis.batch_loop import check_batch_loop
 
