@@ -38,6 +38,8 @@ RULES: Dict[str, str] = {
     "non-atomic-artifact-write": "open(path, 'w'/'wb') on a final artifact path in a persistence module without the tmp+rename discipline; a crash mid-write destroys the previous good artifact",
     # stream-path family (full_materialize.py)
     "full-materialize-in-stream-path": "read_all()/read_table()/whole-table to_numpy inside the streaming tier materializes O(n) rows on host; iterate bounded chunks instead",
+    # unstructured-log family (unstructured_log.py)
+    "unstructured-log-in-library": "logging.getLogger/bare print()/legacy core.config.get_logger in library code; log through obs.logging.get_logger (structured JSON lines with trace correlation)",
     # Params-contract family (params_contract.py)
     "param-converter": "simple Param declared without an explicit type converter",
     "param-doc": "stage or Param missing documentation",

@@ -158,6 +158,15 @@ def run_all(
             ],
             repo_root=root,
         )
+    if "unstructured-log-in-library" in enabled:
+        from mmlspark_tpu.analysis.unstructured_log import (
+            check_unstructured_log,
+        )
+
+        # the whole library tier; the rule itself exempts obs/logging.py
+        # (the one module allowed to own the stdlib machinery) and CLI
+        # tools live outside the package scan
+        findings += check_unstructured_log(package_files, repo_root=root)
     if enabled & _PARAM_RULES:
         from mmlspark_tpu.analysis.params_contract import check_params_contract
 

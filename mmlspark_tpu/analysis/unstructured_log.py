@@ -1,0 +1,130 @@
+"""Unstructured-log rule: library code logs through obs/logging.py only.
+
+``unstructured-log-in-library`` flags, anywhere in ``mmlspark_tpu/`` except
+``obs/logging.py`` (the one module allowed to own the stdlib machinery):
+
+- direct ``logging.getLogger(...)`` calls (any ``import logging`` alias,
+  and bare calls bound by ``from logging import getLogger [as name]``);
+- bare ``print(...)`` calls — stdout is not a log stream in a serving
+  framework (the jit-safety family separately flags prints *inside jit*
+  for the trace-time reason; this rule covers the rest of the library);
+- imports/calls of the deprecated ``core.config.get_logger`` shim — the
+  pre-ISSUE-13 ad-hoc logger factory whose %-format lines carried no trace
+  correlation.
+
+The point is durability, not style: ISSUE 13 migrated every ad-hoc logging
+call site onto ``obs.logging.get_logger`` (JSON lines stamped with the
+active span's trace/span ids), and without a gate the next convenience
+``print()`` or ``logging.getLogger`` un-does the exemplar-to-log linkage
+one call site at a time. Deliberate stdout surfaces (``DataFrame.show``)
+take a line-level ``# graftcheck: ignore[unstructured-log-in-library]``
+where the suppression is visible in review; CLI tools under ``tools/``
+are outside the package scan and keep printing.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Set
+
+from mmlspark_tpu.analysis.base import Finding
+
+_RULE = "unstructured-log-in-library"
+
+#: path suffixes exempt from the rule (the structured logger itself)
+_ALLOWED_SUFFIXES = (os.path.join("obs", "logging.py"),)
+
+
+class _Aliases:
+    """How this module can spell the flagged calls."""
+
+    def __init__(self, tree: ast.AST):
+        self.logging_modules: Set[str] = set()   # import logging [as L]
+        self.getlogger_names: Set[str] = set()   # from logging import getLogger
+        self.legacy_names: Set[str] = set()      # from ...core.config import get_logger
+        self.config_modules: Set[str] = set()    # import ...core.config [as c]
+        self.import_lines: List[tuple] = []      # (line, what) to flag directly
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "logging":
+                        self.logging_modules.add(alias.asname or "logging")
+                    if alias.name == "mmlspark_tpu.core.config":
+                        self.config_modules.add(
+                            alias.asname or "mmlspark_tpu.core.config"
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "logging":
+                    for alias in node.names:
+                        if alias.name == "getLogger":
+                            self.getlogger_names.add(
+                                alias.asname or "getLogger"
+                            )
+                elif node.module == "mmlspark_tpu.core.config":
+                    for alias in node.names:
+                        if alias.name == "get_logger":
+                            self.legacy_names.add(alias.asname or "get_logger")
+                            self.import_lines.append((node.lineno, "import"))
+                elif node.module == "mmlspark_tpu.core":
+                    for alias in node.names:
+                        if alias.name == "config":
+                            self.config_modules.add(alias.asname or "config")
+
+
+def _flag_call(node: ast.Call, aliases: _Aliases) -> str:
+    """Non-empty reason string when this call violates the rule."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        if func.id == "print":
+            return ("bare print() in library code; log through "
+                    "obs.logging.get_logger (or suppress a deliberate "
+                    "stdout surface)")
+        if func.id in aliases.getlogger_names:
+            return ("logging.getLogger in library code; use "
+                    "obs.logging.get_logger for trace-correlated JSON lines")
+        if func.id in aliases.legacy_names:
+            return ("legacy core.config.get_logger call; use "
+                    "obs.logging.get_logger for trace-correlated JSON lines")
+    elif isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        if (func.attr == "getLogger"
+                and func.value.id in aliases.logging_modules):
+            return ("logging.getLogger in library code; use "
+                    "obs.logging.get_logger for trace-correlated JSON lines")
+        if (func.attr == "get_logger"
+                and func.value.id in aliases.config_modules):
+            return ("legacy core.config.get_logger call; use "
+                    "obs.logging.get_logger for trace-correlated JSON lines")
+    return ""
+
+
+def check_unstructured_log(paths: Iterable[str],
+                           repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in paths:
+        rel = os.path.relpath(path, repo_root)
+        # whole-component suffix match: obs/logging.py is exempt,
+        # jobs/logging.py is not
+        if any(rel == sfx or rel.endswith(os.sep + sfx)
+               for sfx in _ALLOWED_SUFFIXES):
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source)
+        except (OSError, SyntaxError):
+            continue
+        aliases = _Aliases(tree)
+        for line, _what in aliases.import_lines:
+            findings.append(Finding(
+                _RULE, rel, line,
+                "imports the legacy core.config.get_logger shim; import "
+                "obs.logging.get_logger instead",
+            ))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            reason = _flag_call(node, aliases)
+            if reason:
+                findings.append(Finding(_RULE, rel, node.lineno, reason))
+    return findings

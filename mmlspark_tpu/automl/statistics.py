@@ -14,7 +14,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from mmlspark_tpu.core import metrics as M
-from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.obs.logging import get_logger
 from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType, Field
 from mmlspark_tpu.core.params import (
     HasEvaluationMetric,
@@ -206,7 +206,7 @@ class ComputeModelStatistics(Transformer, HasLabelCol, HasEvaluationMetric, Wrap
             }
         for key, value in row.items():
             if isinstance(value, float):
-                log.info("metric %s=%0.6f", key, value)
+                log.info("metric", name=key, value=round(value, 6))
         types = {"confusion_matrix": DataType.VECTOR} if "confusion_matrix" in row else None
         return DataFrame.from_dict(
             {k: [v] for k, v in row.items()}, types=types or {}
@@ -272,13 +272,14 @@ class MetricsLogger:
     """
 
     def __init__(self, run_name: str = "run"):
-        from mmlspark_tpu.core.config import get_logger
+        from mmlspark_tpu.obs.logging import get_logger
 
         self.run_name = run_name
         self._log = get_logger("mmlspark_tpu.metrics")
 
     def log_metric(self, name: str, value: float) -> None:
-        self._log.info("metric %s/%s=%r", self.run_name, name, float(value))
+        self._log.info("metric", name=f"{self.run_name}/{name}",
+                       value=float(value))
 
     def log_metrics(self, metrics: dict) -> None:
         for name in sorted(metrics):

@@ -50,12 +50,11 @@ def set(key: str, value: Any) -> None:
 
 
 def get_logger(name: str = "mmlspark_tpu") -> logging.Logger:
-    logger = logging.getLogger(name)
-    if not logger.handlers and not logging.getLogger().handlers:
-        handler = logging.StreamHandler()
-        handler.setFormatter(
-            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
-        )
-        logger.addHandler(handler)
-        logger.setLevel(str(get("sdk.logging.level", "INFO")))
-    return logger
+    """Deprecated: library code logs through
+    mmlspark_tpu.obs.logging.get_logger (structured JSON lines with trace
+    correlation) — graftcheck's `unstructured-log-in-library` rule flags
+    new call sites of this shim. Kept for external callers that want the
+    raw stdlib logger underneath (handler setup included)."""
+    from mmlspark_tpu.obs.logging import stdlib_logger
+
+    return stdlib_logger(name)

@@ -31,12 +31,25 @@ measures against.
   accumulation. ``donation(False)`` is the scoped rollback lever, mirroring
   ``bucketing(False)``; donating and non-donating variants are distinct
   compiled programs, so they must use distinct cache/accounting keys.
+- **AOT cost-model capture**: hot-path callers dispatch through
+  ``aot_program``, which compiles each (key, signature) ONCE via jax's AOT
+  path (``jit_fn.lower(...).compile()``) — the compile is *timed* into the
+  ``dispatch_compile_seconds{site}`` histogram and the executable's
+  ``cost_analysis()`` (flops, bytes accessed) is harvested into the device
+  profiler (obs/profiler.py), making runtime MFU computable per program.
+  Dispatching the returned executable skips jax's python-side cache lookup,
+  and by construction cannot silently recompile. ``aot(False)`` restores
+  the plain jit-call dispatch (the rollback lever); backends where
+  lower/compile or the cost model fail fall back per program, with
+  ``Network.flops_per_example()`` as the documented analytic cross-check.
 """
 
 from __future__ import annotations
 
 import contextlib
 import threading
+import time
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import numpy as np
@@ -78,6 +91,44 @@ def donation(enabled: bool) -> Iterator[None]:
 def donation_enabled() -> bool:
     """Whether donation-backed dispatch is currently enabled."""
     return _DONATION_ENABLED
+
+
+_AOT_ENABLED = True
+
+
+@contextlib.contextmanager
+def aot(enabled: bool) -> Iterator[None]:
+    """Scoped toggle for AOT executable dispatch (True is the default;
+    False makes aot_program return None so callers dispatch the plain jit
+    wrapper — the rollback lever, mirroring bucketing/donation)."""
+    global _AOT_ENABLED
+    prev = _AOT_ENABLED
+    _AOT_ENABLED = enabled
+    try:
+        yield
+    finally:
+        _AOT_ENABLED = prev
+
+
+def _extract_cost(compiled) -> Optional[Dict[str, float]]:
+    """{'flops', 'bytes'} from compiled.cost_analysis(), tolerating the
+    per-version shapes (dict, or list of per-module dicts) and backends
+    with no cost model at all (returns None -> analytic fallback)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception as e:  # backend has no cost model: analytic fallback
+        _aot_log().debug("cost_analysis_unavailable", error=repr(e))
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out: Dict[str, float] = {}
+    if ca.get("flops") is not None:
+        out["flops"] = float(ca["flops"])
+    if ca.get("bytes accessed") is not None:
+        out["bytes"] = float(ca["bytes accessed"])
+    return out or None
 
 
 def bucket_rows(n: int, cap: Optional[int] = None) -> int:
@@ -193,13 +244,19 @@ class DispatchCache:
     eviction rate on a serving box means max_fns is too small for the
     deployed model mix (every eviction is a future recompile)."""
 
-    def __init__(self, max_fns: int = 32):
+    def __init__(self, max_fns: int = 32, max_programs: int = 128):
         from mmlspark_tpu.obs.metrics import registry
 
         self._lock = threading.Lock()
         self._max_fns = max_fns
+        self._max_programs = max_programs
         self._fns: Dict[Any, Callable] = {}
         self._shapes: set = set()
+        # AOT executables, one per (key, input signature); None marks a
+        # program whose lower/compile failed (callers dispatch the jit
+        # wrapper instead — retrying every dispatch would re-pay the failure)
+        self._aot: "OrderedDict[Tuple[Any, Any], Any]" = OrderedDict()
+        self._aot_inflight: Dict[Tuple[Any, Any], threading.Event] = {}
         # process-wide eviction tally (an unlabeled counter: every instance
         # adds to the same series, which is the total the metric means)
         self._evictions = registry().counter(
@@ -222,6 +279,65 @@ class DispatchCache:
                 }
                 self._evictions.inc()
             return self._fns.setdefault(key, fn)
+
+    def aot_program(self, key: Any, signature: Any, jit_fn: Callable,
+                    args: Tuple, site: str = "dispatch") -> Optional[Callable]:
+        """The AOT executable for `key` at `signature` (caller-chosen, must
+        pin everything that changes the program — shape AND dtype). First
+        sighting lowers+compiles via ``jit_fn.lower(*args).compile()``,
+        timing the compile into ``dispatch_compile_seconds{site}`` and
+        harvesting ``cost_analysis()`` into the device profiler; later
+        sightings return the cached executable. Returns None when AOT is
+        rolled back (``aot(False)``) or this program's compile failed —
+        the caller dispatches its plain jit wrapper instead."""
+        if not _AOT_ENABLED:
+            return None
+        entry = (key, signature)
+        # single-flight: concurrent first dispatches of the same entry
+        # (multi-replica servers share this process-wide cache) must not
+        # each pay a multi-second XLA compile — or double-observe
+        # dispatch_compile_seconds and trip the compile-storm counter on
+        # one genuine program. The loser waits for the winner's result.
+        while True:
+            with self._lock:
+                if entry in self._aot:
+                    return self._aot[entry]
+                waiter = self._aot_inflight.get(entry)
+                if waiter is None:
+                    self._aot_inflight[entry] = threading.Event()
+                    break
+            waiter.wait()
+        compiled = None
+        cost = None
+        dt = None
+        try:
+            try:
+                t0 = time.perf_counter()
+                compiled = jit_fn.lower(*args).compile()
+                dt = time.perf_counter() - t0
+                cost = _extract_cost(compiled)
+            except Exception as e:
+                _aot_log().warning(
+                    "aot_compile_failed", site=site, error=repr(e),
+                    signature=[str(s) for s in signature]
+                    if isinstance(signature, (tuple, list))
+                    else str(signature),
+                )
+            if dt is not None:
+                from mmlspark_tpu.obs.profiler import device_profiler
+
+                device_profiler().note_compile(key, signature, site, dt, cost)
+        finally:
+            # always release waiters — a BaseException here must not park
+            # other dispatch threads forever (an interrupted compile caches
+            # None, the same plain-jit fallback as a failed one)
+            with self._lock:
+                while len(self._aot) >= self._max_programs:
+                    self._aot.popitem(last=False)
+                    self._evictions.inc()
+                self._aot[entry] = compiled
+                self._aot_inflight.pop(entry).set()
+        return compiled
 
     def note_dispatch(self, key: Any, shape: Tuple[int, ...]) -> bool:
         """Record a dispatch of `key` at `shape`; returns True (and counts a
@@ -247,6 +363,13 @@ class DispatchCache:
         with self._lock:
             self._fns.clear()
             self._shapes.clear()
+            self._aot.clear()
+
+
+def _aot_log():
+    from mmlspark_tpu.obs.logging import get_logger
+
+    return get_logger("mmlspark_tpu.dispatch")
 
 
 _CACHE = DispatchCache()
@@ -266,6 +389,10 @@ def _register_cache_gauges() -> None:
         "dispatch_cache_programs",
         "Distinct (program, shape) pairs dispatched",
     ).set_function(lambda: float(len(_CACHE._shapes)))
+    reg.gauge(
+        "dispatch_cache_aot_programs",
+        "AOT executables currently cached (cost-model capture path)",
+    ).set_function(lambda: float(len(_CACHE._aot)))
 
 
 _register_cache_gauges()

@@ -20,6 +20,49 @@ import numpy as np
 _initialized_distributed = False
 
 
+# -- peak-FLOPs table (the MFU denominator) -----------------------------------
+#
+# bf16 peak FLOP/s by TPU device kind, from the public spec sheets. This is
+# the single source both the offline bench (bench.py mfu lines) and the
+# runtime profiler's `device_mfu` gauges (obs/profiler.py) divide by, so
+# "6% MFU in the bench artifact" and "0.06 on /metrics" mean the same thing.
+PEAK_FLOPS_BY_KIND = {
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+# Nominal peak for the CPU backend: one modern x86 core sustains roughly
+# 100 GFLOP/s of f32 FMA (AVX2, 2 FMA ports). A deliberately coarse anchor —
+# CPU MFU numbers are for *relative* movement (a regression doubling device
+# seconds halves the gauge) and for exercising the MFU plumbing in CI, not
+# for absolute hardware claims. The profiler smoke bench compares runtime
+# and analytic MFU against this same constant, so the tolerance gate is
+# self-consistent (docs/observability.md "Profiling & MFU").
+CPU_NOMINAL_PEAK_FLOPS = 100e9
+
+
+def peak_flops_per_sec() -> float:
+    """Best-effort peak FLOP/s for the attached backend: the bf16 table for
+    known TPU kinds, the documented nominal for CPU, 0.0 when unknown
+    (callers omit MFU rather than report a wrong one)."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return CPU_NOMINAL_PEAK_FLOPS
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in PEAK_FLOPS_BY_KIND.items():
+        if key in kind:
+            return peak
+    return 0.0
+
+
 def device_count() -> int:
     import jax
 

@@ -16,7 +16,7 @@ import os
 import shutil
 from typing import Dict, Iterator, List, Optional
 
-from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.obs.logging import get_logger
 from mmlspark_tpu.downloader.schema import (
     ModelSchema,
     hash_model_dir,
@@ -120,7 +120,8 @@ class ModelDownloader:
                 schema.assert_matching_hash(dest)
                 return schema.with_uri(dest)
             except ValueError:
-                log.info("local copy of %s stale; re-fetching", schema.name)
+                log.info("model_cache_stale", model=schema.name,
+                         action="re-fetching")
                 shutil.rmtree(dest)
         if schema.builder:
             _materialize_builder(schema.builder, dest)

@@ -258,12 +258,12 @@ class Booster:
             _counters().record_d2h(x_sample.nbytes + out_sample.nbytes)
         ref = self._walk_numpy(np.asarray(x_sample), packed)
         if not np.allclose(out_sample, ref, rtol=1e-5, atol=1e-6):
-            from mmlspark_tpu.core.config import get_logger
+            from mmlspark_tpu.obs.logging import get_logger
 
             get_logger("mmlspark_tpu.gbdt").warning(
-                "device tree-walk disagreed with the host reference at "
-                "shape %s x %s trees; recomputing on host",
-                x.shape, packed["feats"].shape[0],
+                "gbdt_device_walk_mismatch",
+                shape=list(x.shape), trees=int(packed["feats"].shape[0]),
+                action="recomputing on host",
             )
             x_host = np.asarray(x)
             if device_in:

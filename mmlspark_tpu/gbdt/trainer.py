@@ -25,8 +25,8 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from mmlspark_tpu.core.config import get_logger
 from mmlspark_tpu.obs import tracer as obs_tracer
+from mmlspark_tpu.obs.logging import get_logger
 from mmlspark_tpu.obs.metrics import registry as obs_registry
 from mmlspark_tpu.gbdt.binning import BinMapper
 from mmlspark_tpu.gbdt.booster import Booster
@@ -46,6 +46,49 @@ _FORCE_SINGLE_DEVICE = False
 # Test hook: force the legacy per-iteration loop so fused-vs-legacy tree
 # identity can be asserted (tests/test_gbdt.py fused parity).
 _FORCE_LEGACY_LOOP = False
+
+
+def _hist_pass_flops(rows: int, features: int, num_bins: int,
+                     num_leaves: int, num_class: int) -> float:
+    """Analytic FLOPs for ONE boosting iteration's histogram work — the
+    documented estimate behind the gbdt `device_mfu` gauge (the fused boost
+    loop is one monolithic XLA program, so per-round cost-model harvesting
+    does not apply the way it does for cached forward programs).
+
+    The one-hot einsum histogram (gbdt/compute.py) does ~2 FLOPs per
+    (row, feature, bin) cell for each of grad/hess/count = 6, once per tree
+    level; a num_leaves-leaf tree is ~log2(num_leaves) levels of full-row
+    passes. Split finding and routing are lower-order next to it."""
+    levels = max(1.0, float(np.ceil(np.log2(max(2, num_leaves)))))
+    return 6.0 * rows * features * num_bins * levels * max(1, num_class)
+
+
+def _round_device_hist():
+    return obs_registry().histogram(
+        "gbdt_round_device_seconds",
+        "Device-synchronous wall seconds per boosting round (fused: the "
+        "one boost program's wall divided by its iterations, observed "
+        "once per fit; streamed: each round observed individually)",
+        ("engine",),
+    )
+
+
+def _record_boost_device_work(engine: str, seconds: float, iterations: int,
+                              rows: int, features: int, num_bins: int,
+                              num_leaves: int, num_class: int) -> None:
+    """Per-round device seconds + histogram-pass MFU for a boost run —
+    no-ops (like every profiler hook) under obs.disabled()."""
+    from mmlspark_tpu.obs.profiler import device_profiler
+
+    prof = device_profiler()
+    if not prof.enabled or seconds <= 0 or iterations <= 0:
+        return
+    _round_device_hist().labels(engine=engine).observe(seconds / iterations)
+    prof.record_device_work(
+        site=f"gbdt:{engine}", model="gbdt", seconds=seconds,
+        flops=_hist_pass_flops(rows, features, num_bins, num_leaves,
+                               num_class) * iterations,
+    )
 
 
 class _ValidTracker:
@@ -75,11 +118,13 @@ class _ValidTracker:
         if improved:
             self.best_metric, self.best_iter = value, it
         if self.verbosity > 0 and (it % 10 == 0):
-            self.log.info("iter %d %s=%.6f", it, name, value)
+            self.log.info("gbdt_eval", iteration=it, metric=name,
+                          value=round(float(value), 6))
         if self.esr > 0 and it - self.best_iter >= self.esr:
             self.log.info(
-                "early stop at iter %d (best %d, %s=%.6f)",
-                it, self.best_iter, name, self.best_metric,
+                "gbdt_early_stop", iteration=it,
+                best_iteration=self.best_iter, metric=name,
+                value=round(float(self.best_metric), 6),
             )
             return True
         return False
@@ -556,6 +601,19 @@ def train_booster(
                     jnp.asarray(vrows.astype(np.int32)) if has_valid else None
                 ),
             )
+            # per-round device seconds + histogram-pass MFU (obs/profiler):
+            # the fused loop is ONE device program, so block on it and
+            # average over its iterations (wall includes the compile on the
+            # first shape; the bench pre-warms before gating). Skipped
+            # entirely under obs.disabled() — the results are fetched just
+            # below either way, so the early block costs nothing extra.
+            if obs_registry().enabled:
+                jax.block_until_ready(result)
+                _record_boost_device_work(
+                    "fused", time.perf_counter() - t_boost,
+                    cfg.num_iterations, n_orig, f, num_bins_static,
+                    cfg.num_leaves, k,
+                )
         finally:
             # a failed fit's dominant phase must still reach the trace ring
             # and the histogram — that run is the one being diagnosed
@@ -1176,6 +1234,7 @@ def _train_booster_streamed(
     )
     try:
         for it in range(start_iter, start_iter + cfg.num_iterations):
+            t_round = time.perf_counter()
             if use_bagging and it % max(1, cfg.bagging_freq) == 0:
                 bag_mask = bag_draw() < cfg.bagging_fraction
             if cfg.feature_fraction < 1.0:
@@ -1212,8 +1271,16 @@ def _train_booster_streamed(
                     raw[:, c] += leaf_vals[assign]
                 else:
                     raw += leaf_vals[assign]
+            # per-round device seconds + hist-pass MFU: the streamed loop
+            # is device-synchronous (every chunk pass lands in np.asarray),
+            # so the round wall IS queue+device time; no-op when disabled
+            _record_boost_device_work(
+                "streamed", time.perf_counter() - t_round, 1, n, f,
+                num_bins, cfg.num_leaves, k,
+            )
             if cfg.verbosity > 0 and (it % 10 == 0):
-                log.info("streamed iter %d (%d trees)", it, len(trees))
+                log.info("gbdt_streamed_progress", iteration=it,
+                         trees=len(trees))
     finally:
         tr.end_span(boost_span)
         phase_hist.labels(phase="boost_streamed").observe(
@@ -1627,9 +1694,8 @@ def _train_booster_checkpointed(
             }
             done = int(ck.meta["iters_done"])
             log.info(
-                "resuming boosting from checkpoint generation %d "
-                "(%d/%d iterations done)",
-                ck.generation, done, cfg.num_iterations,
+                "gbdt_resume", generation=ck.generation, iters_done=done,
+                total_iterations=cfg.num_iterations,
             )
 
         while done < cfg.num_iterations:

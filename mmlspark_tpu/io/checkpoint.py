@@ -59,7 +59,7 @@ from typing import Any, Dict, Iterator, List, Optional
 
 import numpy as np
 
-from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.obs.logging import get_logger
 
 log = get_logger("mmlspark_tpu.io.checkpoint")
 
@@ -468,8 +468,8 @@ class CheckpointStore:
         bytes_total.inc(total)
         gen_gauge.set(gen)
         self._retain()
-        log.debug("checkpoint gen %d committed (%d files, %d bytes) at %s",
-                  gen, len(files), total, self.root)
+        log.debug("checkpoint_committed", generation=gen,
+                  files=len(files), bytes=total, root=self.root)
         return gen
 
     def _gc_tmp(self) -> None:
@@ -540,7 +540,7 @@ class CheckpointStore:
                 shutil.rmtree(dst, ignore_errors=True)
             os.replace(src, dst)
         except OSError:  # quarantine is best-effort; the skip is what matters
-            log.warning("could not quarantine %s", src)
+            log.warning("quarantine_failed", path=src)
 
     def load_latest(self) -> Optional[Checkpoint]:
         """Newest intact generation, or None when the store holds none.
@@ -558,8 +558,8 @@ class CheckpointStore:
                 try:
                     ck = self._verify_gen(gen)
                 except CorruptArtifactError as e:
-                    log.warning("checkpoint gen %d failed verification: %s",
-                                gen, e.reason)
+                    log.warning("checkpoint_verification_failed",
+                                generation=gen, reason=e.reason)
                     self._quarantine(gen, e.reason.split("(")[0].strip())
                     fell_back = True
                     continue

@@ -26,7 +26,7 @@ import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable, Iterator, List, Optional, Tuple
 
-from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.obs.logging import get_logger
 from mmlspark_tpu.io.http.schema import (
     EntityData,
     HeaderData,
@@ -145,13 +145,15 @@ def send_with_retries(
                 )
                 delay = _parse_retry_after(retry_after)
                 if delay is not None:
-                    log.info("429: waiting %.1fs on %s", delay, request.request_line.uri)
+                    log.info("http_rate_limited", wait_s=round(delay, 1),
+                             uri=request.request_line.uri)
                     time.sleep(delay)
                 # 429 retries without consuming extra backoff beyond the schedule
             else:
                 log.warning(
-                    "got error %d: %s on %s",
-                    code, response.status_line.reason_phrase, request.request_line.uri,
+                    "http_error_response", code=code,
+                    reason=response.status_line.reason_phrase,
+                    uri=request.request_line.uri,
                 )
         if attempt < len(retries_ms):
             time.sleep(retries_ms[attempt] / 1000.0)

@@ -34,7 +34,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
-from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.obs.logging import get_logger
 
 log = get_logger("mmlspark_tpu.io.checkpoint")
 
@@ -165,15 +165,15 @@ class StorageFaultInjector:
             return
         kind = fault["kind"]
         if kind == "crash_at_op" or kind == "crash":
-            log.info("fault: crash before write of %s", path)
+            log.info("storage_fault", fault="crash_before_write", path=path)
             raise InjectedCrash(f"crash before write {path}")
         if kind == "torn":
             with open(path, "wb") as f:  # deliberately torn: the fault under test  # graftcheck: ignore[non-atomic-artifact-write]
                 f.write(data[: fault["at_byte"]])
                 f.flush()
                 os.fsync(f.fileno())
-            log.info("fault: torn write of %s at byte %d", path,
-                     fault["at_byte"])
+            log.info("storage_fault", fault="torn_write", path=path,
+                     at_byte=fault["at_byte"])
             raise InjectedCrash(f"torn write {path}@{fault['at_byte']}")
         if kind == "enospc":
             with open(path, "wb") as f:  # deliberately partial: ENOSPC under test  # graftcheck: ignore[non-atomic-artifact-write]
@@ -187,7 +187,7 @@ class StorageFaultInjector:
         if fault["kind"] == "slow":
             time.sleep(fault["delay_s"])
             return
-        log.info("fault: crash at fsync of %s", path)
+        log.info("storage_fault", fault="crash_at_fsync", path=path)
         raise InjectedCrash(f"crash at fsync {path}")
 
     def on_replace(self, src: str, dst: str,
@@ -198,10 +198,12 @@ class StorageFaultInjector:
             return
         kind = fault["kind"]
         if kind in ("crash_before", "crash_at_op", "crash"):
-            log.info("fault: crash BEFORE rename %s -> %s", src, dst)
+            log.info("storage_fault", fault="crash_before_rename",
+                     src=src, dst=dst)
             raise InjectedCrash(f"crash before rename {dst}")
         do_replace(src, dst)
-        log.info("fault: crash AFTER rename %s -> %s", src, dst)
+        log.info("storage_fault", fault="crash_after_rename",
+                 src=src, dst=dst)
         raise InjectedCrash(f"crash after rename {dst}")
 
 

@@ -24,12 +24,13 @@ test-mode guarantee SURVEY.md §4 carries over from local[*].
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from mmlspark_tpu.core.config import get_logger
 from mmlspark_tpu.core.dataframe import DataFrame, DataType, Field
+from mmlspark_tpu.obs.logging import get_logger
 from mmlspark_tpu.core.params import (
     ComplexParam,
     HasFeaturesCol,
@@ -348,8 +349,8 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
                 rng.bit_generator.state = json.loads(ck.text("np_rng.json"))
                 losses = [float(v) for v in ck.meta["losses"]]
                 log.info(
-                    "resuming fit from checkpoint generation %d at epoch %d",
-                    ck.generation, start_epoch,
+                    "learner_resume", generation=ck.generation,
+                    epoch=start_epoch,
                 )
 
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -392,7 +393,20 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
         steps_per_epoch = -(-n // bs)  # ceil: the final partial batch is
         # padded with zero-weight rows, never dropped
         epochs = self.get(self.epochs)
+        # per-epoch device-utilization accounting (obs/profiler.py): the
+        # step loop syncs every loss scalar, so epoch wall is queue+device
+        # time; training FLOPs per example are estimated at 3x the forward
+        # MACs (backward ~2x forward — the standard accounting), with
+        # dnn/network.py's analytic count as the base. No-op when disabled.
+        from mmlspark_tpu.obs.profiler import device_profiler
+
+        prof = device_profiler()
+        learner_label = "tpu_learner:" + "x".join(
+            str(d) for d in net.input_shape
+        )
+        fwd_flops = net.flops_per_example() if prof.enabled else 0.0
         for epoch in range(start_epoch, epochs):
+            t_epoch = time.perf_counter()
             order = rng.permutation(n) if self.get(self.shuffle) else np.arange(n)
             epoch_loss = 0.0
             count = 0
@@ -418,7 +432,14 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
                 epoch_loss += float(loss) * len(idx)
                 count += len(idx)
             losses.append(epoch_loss / max(1, count))
-            log.debug("epoch %d loss %.5f", epoch, losses[-1])
+            if prof.enabled:
+                prof.record_device_work(
+                    site="tpu_learner.epoch", model=learner_label,
+                    seconds=time.perf_counter() - t_epoch,
+                    flops=3.0 * fwd_flops * count,
+                )
+            log.debug("learner_epoch", epoch=epoch,
+                      loss=round(losses[-1], 5))
             if store is not None and (
                 (epoch + 1) % max(1, every) == 0 or epoch == epochs - 1
             ):

@@ -20,7 +20,8 @@ TPU-native design choices vs the reference:
 
 from __future__ import annotations
 
-from typing import List, Optional
+import time
+from typing import Any, List, Optional
 
 import numpy as np
 
@@ -317,6 +318,20 @@ class TPUModel(Model, Wrappable):
         counters = dataplane_counters()
         device_in = is_device_array(x)
         dispatch_rows = _dispatch_rows_hist()
+        # device-utilization profiling (obs/profiler.py): per-dispatch
+        # flight records, cost-model flops per program, and 1-in-N sampled
+        # device timing feeding the rolling device_mfu{model} gauge. All of
+        # it no-ops under obs.disabled() (the <=5% overhead rollback).
+        from mmlspark_tpu.obs.profiler import device_profiler
+
+        prof = device_profiler()
+        profiling = prof.enabled
+        model_label = "tpu_model:" + "x".join(
+            str(d) for d in net.input_shape
+        )
+        # analytic forward MACs (dnn/network.py): the documented fallback /
+        # cross-check for backends where XLA's cost model is unavailable
+        flops_per_row = net.flops_per_example() if profiling else 0.0
 
         if self.get(self.use_mesh):
             from mmlspark_tpu.parallel.mesh import data_parallel_mesh
@@ -356,6 +371,7 @@ class TPUModel(Model, Wrappable):
         spilled: list = []  # np arrays already fetched (large-output case)
         dev_elems = 0
         for start in range(0, n, bs):
+            t_queue = time.monotonic()
             # slice_rows is a no-op for single-chunk inputs (every serving
             # request) and a compiled static-bound slice for device input —
             # an eager x[a:b] would promote its index scalars host->device,
@@ -385,12 +401,42 @@ class TPUModel(Model, Wrappable):
             # the input column's own storage would delete it under the
             # caller's feet, so those dispatches stay non-donating.
             donate = fn_donate is not None and (not device_in or padded is not x)
-            cache.note_dispatch(
-                fkey_donate if donate else fkey,
-                (int(padded.shape[0]),) + tuple(x.shape[1:]),
-            )
+            dkey = fkey_donate if donate else fkey
+            bshape = (int(padded.shape[0]),) + tuple(x.shape[1:])
+            first = cache.note_dispatch(dkey, bshape)
             dispatch_rows.observe(int(padded.shape[0]))
-            y = (fn_donate if donate else fn)(variables, xd)
+            # cost-model capture path: the single-device forward dispatches
+            # through the AOT executable (compile timed + cost_analysis
+            # harvested per program); the mesh path keeps the plain jit
+            # wrapper (sharded-input avals are the mesh runtime's business).
+            # The signature pins shape AND dtype AND input sharding: an AOT
+            # executable refuses a resharded same-shape input where plain
+            # jit would silently recompile (a mesh-sharded parse-stage
+            # column reaching a single-device model is exactly that case)
+            sig = bshape + (
+                str(padded.dtype), str(getattr(xd, "sharding", "")),
+            )
+            jfn = fn_donate if donate else fn
+            program = (
+                cache.aot_program(dkey, sig, jfn, (variables, xd),
+                                  site="tpu_model.forward")
+                if in_shard is None else None
+            )
+            y = (program or jfn)(variables, xd)
+            if profiling:
+                t_dispatched = time.monotonic()
+                dev_s = None
+                if prof.should_sample():
+                    y.block_until_ready()
+                    dev_s = time.monotonic() - t_dispatched
+                prof.record_dispatch(
+                    site="tpu_model.forward", model=model_label,
+                    key=dkey, signature=sig, rows=real,
+                    t_queue=t_queue, t_dispatch=t_dispatched,
+                    device_s=dev_s,
+                    fallback_flops=flops_per_row * int(padded.shape[0]),
+                    donated=donate, first_compile=first,
+                )
             in_flight.append(y)
             results.append((y, real))
             dev_elems += int(np.prod(y.shape))

@@ -17,6 +17,13 @@ One subsystem every layer reports into, scrapeable over HTTP
   stages up against `profile_to`'s device traces.
 - **Liveness**: ``GET /healthz`` on a `ServingServer` reports engine thread
   health, queue depth, in-flight batches and last-dispatch age.
+- **Profiling** (`obs.profiler`): XLA cost-model MFU accounting, 1-in-N
+  sampled device timing, and a bounded per-dispatch flight recorder served
+  at ``GET /debug/flight`` (``GET /debug/trace`` serves the tracer ring as
+  Chrome trace_event JSON).
+- **Structured logging** (`obs.logging`): JSON-lines log records stamped
+  with the active span's trace/span ids — the library's only log emitter
+  (pinned by graftcheck's `unstructured-log-in-library` rule).
 
 `set_enabled(False)` turns the whole layer off (metrics AND tracing) — the
 rollback lever the overhead smoke bench (bench.run_obs_overhead_smoke,
@@ -28,6 +35,7 @@ from __future__ import annotations
 import contextlib
 from typing import Iterator
 
+from mmlspark_tpu.obs.logging import StructuredLogger, get_logger
 from mmlspark_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -36,6 +44,11 @@ from mmlspark_tpu.obs.metrics import (
     QuantileSketch,
     parse_prometheus,
     registry,
+)
+from mmlspark_tpu.obs.profiler import (
+    DeviceProfiler,
+    device_profiler,
+    profiler_sampling,
 )
 from mmlspark_tpu.obs.tracing import Span, Tracer, current_span, tracer
 
@@ -51,6 +64,11 @@ __all__ = [
     "Tracer",
     "current_span",
     "tracer",
+    "StructuredLogger",
+    "get_logger",
+    "DeviceProfiler",
+    "device_profiler",
+    "profiler_sampling",
     "set_enabled",
     "disabled",
 ]

@@ -1,0 +1,161 @@
+"""Structured, trace-correlated logging — the library's ONE log emitter.
+
+Every log line is a single JSON object (JSON Lines): machine-parseable,
+greppable by field, and stamped with the active span's ``trace_id`` /
+``span_id`` from the tracing contextvar (obs/tracing.py) whenever one is
+recording — so a warning emitted inside a served request links straight to
+that request's trace in the flight recorder, the same id a histogram
+exemplar carries (docs/observability.md "Exemplars").
+
+    from mmlspark_tpu.obs.logging import get_logger
+    log = get_logger("mmlspark_tpu.serving")
+    log.warning("slow_request", request_id=rid, latency_ms=412.0)
+    # -> {"event": "slow_request", "latency_ms": 412.0, "level": "WARNING",
+    #     "logger": "mmlspark_tpu.serving", "request_id": "...",
+    #     "trace_id": "9f2c...", "span_id": "01ab...", "ts": 1754300000.123}
+
+The first positional argument is the **event name** — a stable snake_case
+identifier you alert/aggregate on; everything else is keyword fields.
+Messages ride stdlib ``logging`` underneath (one ``%(message)s`` handler on
+the ``mmlspark_tpu`` parent logger), so level configuration
+(``MMLSPARK_TPU_SDK_LOGGING_LEVEL``), ``caplog``, and any handlers the host
+application installs keep working — only the message *payload* is
+structured.
+
+graftcheck's ``unstructured-log-in-library`` rule pins this in place:
+direct ``logging.getLogger`` / bare ``print(`` / legacy
+``core.config.get_logger`` call sites anywhere else in ``mmlspark_tpu/``
+fail the tier-1 package scan (docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+import json
+import logging as _stdlib
+import threading
+import time
+import traceback
+from typing import Any, Dict
+
+__all__ = ["StructuredLogger", "get_logger", "stdlib_logger"]
+
+_setup_lock = threading.Lock()
+_cache: Dict[str, "StructuredLogger"] = {}
+
+
+def stdlib_logger(name: str = "mmlspark_tpu") -> _stdlib.Logger:
+    """The underlying stdlib logger for `name`, with the package handler
+    installed once on the `mmlspark_tpu` parent (message-only format — the
+    structured payload IS the line). Deferential like the old
+    core/config.get_logger: when the host application configured root
+    handlers, we emit through those instead of adding our own."""
+    logger = _stdlib.getLogger(name)
+    # install the handler on the ancestor that actually covers `name`: the
+    # package parent for in-package loggers, the named logger itself for
+    # external names (which never propagate into the mmlspark_tpu
+    # hierarchy — the old core/config.get_logger contract).
+    in_pkg = name == "mmlspark_tpu" or name.startswith("mmlspark_tpu.")
+    owner = _stdlib.getLogger("mmlspark_tpu") if in_pkg else logger
+    with _setup_lock:
+        if not owner.handlers and not _stdlib.getLogger().handlers:
+            from mmlspark_tpu.core.config import get as _cfg_get
+
+            handler = _stdlib.StreamHandler()
+            handler.setFormatter(_stdlib.Formatter("%(message)s"))
+            owner.addHandler(handler)
+            owner.setLevel(str(_cfg_get("sdk.logging.level", "INFO")))
+    return logger
+
+
+def _jsonable(v: Any) -> Any:
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple, set, frozenset)):
+        return [_jsonable(x) for x in v]
+    item = getattr(v, "item", None)  # numpy scalars
+    if callable(item) and getattr(v, "ndim", None) == 0:
+        try:
+            return item()
+        except (TypeError, ValueError):
+            pass
+    return repr(v)
+
+
+class StructuredLogger:
+    """JSON-lines logger with automatic trace correlation.
+
+    Methods mirror stdlib levels but take ``(event, **fields)`` instead of
+    a format string: ``log.info("worker_started", port=8899)``. Reserved
+    keys the emitter owns (``event``, ``level``, ``logger``, ``ts``,
+    ``trace_id``, ``span_id``, ``exc``) are not overridable by fields.
+    An explicit ``trace_id=`` field wins over the contextvar — callers
+    holding a span object for a request whose context is gone (e.g. the
+    HTTP edge after the span ended) pass it through."""
+
+    __slots__ = ("name", "_logger")
+
+    _RESERVED = ("event", "level", "logger", "ts", "exc")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._logger = stdlib_logger(name)
+
+    def _emit(self, level: int, event: str, fields: Dict[str, Any],
+              exc: bool = False) -> None:
+        if not self._logger.isEnabledFor(level):
+            return
+        rec: Dict[str, Any] = {
+            "event": event,
+            "level": _stdlib.getLevelName(level),
+            "logger": self.name,
+            # absolute wall-clock timestamp (legitimate time.time() use:
+            # log records are anchors, never differenced)
+            "ts": round(time.time(), 6),
+        }
+        trace_id = fields.pop("trace_id", None)
+        span_id = fields.pop("span_id", None)
+        if trace_id is None:
+            from mmlspark_tpu.obs.tracing import current_span
+
+            span = current_span()
+            if span is not None and span.recording:
+                trace_id, span_id = span.trace_id, span.span_id
+        if trace_id is not None:
+            rec["trace_id"] = trace_id
+        if span_id is not None:
+            rec["span_id"] = span_id
+        for k, v in fields.items():
+            if k not in self._RESERVED:
+                rec[k] = _jsonable(v)
+        if exc:
+            rec["exc"] = traceback.format_exc()
+        self._logger.log(level, json.dumps(rec, sort_keys=True, default=repr))
+
+    def debug(self, event: str, **fields: Any) -> None:
+        self._emit(_stdlib.DEBUG, event, fields)
+
+    def info(self, event: str, **fields: Any) -> None:
+        self._emit(_stdlib.INFO, event, fields)
+
+    def warning(self, event: str, **fields: Any) -> None:
+        self._emit(_stdlib.WARNING, event, fields)
+
+    def error(self, event: str, **fields: Any) -> None:
+        self._emit(_stdlib.ERROR, event, fields)
+
+    def exception(self, event: str, **fields: Any) -> None:
+        """ERROR line carrying the active exception's traceback (`exc`)."""
+        self._emit(_stdlib.ERROR, event, fields, exc=True)
+
+    def isEnabledFor(self, level: int) -> bool:
+        return self._logger.isEnabledFor(level)
+
+
+def get_logger(name: str = "mmlspark_tpu") -> StructuredLogger:
+    """The structured logger for `name` (cached per name)."""
+    logger = _cache.get(name)
+    if logger is None:
+        logger = _cache.setdefault(name, StructuredLogger(name))
+    return logger

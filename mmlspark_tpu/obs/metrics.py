@@ -26,7 +26,14 @@ because the sketch gives exact-ish quantiles without fixed buckets.
 from __future__ import annotations
 
 import threading
+import time
+import urllib.parse
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+# top-level on purpose: observe() consults the active span per call, and a
+# function-level import would re-run import machinery on the hot path.
+# No cycle: tracing imports metrics only lazily (_dropped_counter).
+from mmlspark_tpu.obs.tracing import current_span as _current_span
 
 __all__ = [
     "QuantileSketch",
@@ -266,8 +273,8 @@ class _GaugeChild:
             return float(fn())
         except Exception as e:
             # a dead callback must not kill the whole scrape; surface it as
-            # NaN and log once at debug
-            _log().debug("gauge callback failed: %r", e)
+            # NaN and log at debug
+            _log().debug("gauge_callback_failed", error=repr(e))
             return float("nan")
 
 
@@ -294,20 +301,46 @@ class Gauge(_Family):
 
 
 class _HistogramChild:
-    __slots__ = ("_fam", "_lock", "_sketch", "_sum")
+    __slots__ = ("_fam", "_lock", "_sketch", "_sum", "_exemplars")
 
     def __init__(self, fam: "Histogram"):
         self._fam = fam
         self._lock = threading.Lock()
         self._sketch = QuantileSketch(fam.sketch_k)
         self._sum = 0.0
+        # recent trace-linked observations (value, trace_id, span_id, ts);
+        # exposition renders the max-valued one so a p99 spike on the
+        # scrape links to the trace that caused it (OpenMetrics exemplars)
+        self._exemplars: List[Tuple[float, str, Optional[str], float]] = []
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None,
+                span_id: Optional[str] = None) -> None:
+        """Record one observation. When the histogram family has exemplars
+        enabled, the active span's trace/span ids (or an explicit
+        `trace_id=` for callers whose span already left the contextvar —
+        the HTTP edge) ride along and surface in the exposition."""
         if not self._fam._reg._enabled:
             return
+        if self._fam.exemplars and trace_id is None:
+            span = _current_span()
+            if span is not None and span.recording:
+                trace_id, span_id = span.trace_id, span.span_id
         with self._lock:
             self._sketch.add(value)
             self._sum += value
+            if trace_id is not None and self._fam.exemplars:
+                self._exemplars.append(
+                    (float(value), str(trace_id), span_id, time.time())
+                )
+                if len(self._exemplars) > 8:
+                    del self._exemplars[0]
+
+    def exemplar(self) -> Optional[Tuple[float, str, Optional[str], float]]:
+        """The max-valued recent trace-linked observation, or None."""
+        with self._lock:
+            if not self._exemplars:
+                return None
+            return max(self._exemplars, key=lambda e: e[0])
 
     def quantile(self, q: float) -> float:
         with self._lock:
@@ -341,16 +374,19 @@ class Histogram(_Family):
 
     def __init__(self, reg, name, help, labelnames,
                  quantiles: Tuple[float, ...] = (0.5, 0.95, 0.99),
-                 sketch_k: int = 128):
+                 sketch_k: int = 128, exemplars: bool = True):
         super().__init__(reg, name, help, labelnames)
         self.quantiles = tuple(quantiles)
         self.sketch_k = sketch_k
+        self.exemplars = exemplars
 
     def _make_child(self) -> _HistogramChild:
         return _HistogramChild(self)
 
-    def observe(self, value: float) -> None:
-        self._default_child().observe(value)
+    def observe(self, value: float, trace_id: Optional[str] = None,
+                span_id: Optional[str] = None) -> None:
+        self._default_child().observe(value, trace_id=trace_id,
+                                      span_id=span_id)
 
     def quantile(self, q: float) -> float:
         return self._default_child().quantile(q)
@@ -363,7 +399,7 @@ class Histogram(_Family):
 
 
 def _log():
-    from mmlspark_tpu.core.config import get_logger
+    from mmlspark_tpu.obs.logging import get_logger
 
     return get_logger("mmlspark_tpu.obs")
 
@@ -444,9 +480,10 @@ class MetricsRegistry:
     def histogram(self, name: str, help: str = "",
                   labelnames: Iterable[str] = (),
                   quantiles: Tuple[float, ...] = (0.5, 0.95, 0.99),
-                  sketch_k: int = 128) -> Histogram:
+                  sketch_k: int = 128, exemplars: bool = True) -> Histogram:
         return self._family(Histogram, name, help, labelnames,
-                            quantiles=quantiles, sketch_k=sketch_k)
+                            quantiles=quantiles, sketch_k=sketch_k,
+                            exemplars=exemplars)
 
     def families(self) -> List[_Family]:
         with self._lock:
@@ -454,8 +491,15 @@ class MetricsRegistry:
 
     # -- exposition -----------------------------------------------------------
 
-    def render_prometheus(self) -> str:
-        """The registry in Prometheus text exposition format 0.0.4."""
+    def render_prometheus(self, exemplars: bool = False) -> str:
+        """The registry in Prometheus text exposition format 0.0.4.
+
+        ``exemplars=True`` appends OpenMetrics-style exemplars to histogram
+        ``_count`` lines. That syntax is NOT part of the classic text
+        format — a stock Prometheus scraper would reject the whole payload
+        — so servers emit it only on the explicit ``GET /metrics?
+        exemplars=1`` diagnostic opt-in (render_scrape); the default
+        exposition stays classic-parser safe."""
         lines: List[str] = []
         for fam in self.families():
             if fam.help:
@@ -472,8 +516,27 @@ class MetricsRegistry:
                             + f" {_format_value(snap[f'q{q}'])}"
                         )
                     base = _render_labels(fam.labelnames, key)
+                    # OpenMetrics exemplar on the _count series: the max
+                    # recent trace-linked observation, so a latency spike on
+                    # the scrape carries the trace id that explains it.
+                    # Rendered only when the caller asked (OpenMetrics
+                    # negotiation) and suppressed while the registry is
+                    # disabled (rollback parity).
+                    ex = ""
+                    exemplar = (child.exemplar()
+                                if exemplars and self._enabled else None)
+                    if exemplar is not None:
+                        v, tid, sid, ts = exemplar
+                        pairs = [("trace_id", tid)]
+                        if sid:
+                            pairs.append(("span_id", sid))
+                        exl = ",".join(
+                            f'{n}="{_escape_label(x)}"' for n, x in pairs
+                        )
+                        ex = (f" # {{{exl}}} {_format_value(v)} "
+                              f"{round(ts, 3)}")
                     lines.append(f"{fam.name}_count{base} "
-                                 f"{_format_value(snap['count'])}")
+                                 f"{_format_value(snap['count'])}{ex}")
                     lines.append(f"{fam.name}_sum{base} "
                                  f"{_format_value(snap['sum'])}")
                 else:
@@ -483,38 +546,122 @@ class MetricsRegistry:
                     )
         return "\n".join(lines) + "\n"
 
+    def render_scrape(self, query: str = "") -> Tuple[bytes, str]:
+        """(body, content_type) for a GET /metrics exchange. The default is
+        ALWAYS the classic 0.0.4 text a stock Prometheus parser accepts —
+        regardless of Accept headers, which stock Prometheus fills with
+        ``application/openmetrics-text`` by default while our exemplar
+        exposition is OpenMetrics-STYLE, not spec-complete (exemplars ride
+        summary-family ``_count`` lines). Exemplars are an explicit
+        diagnostic opt-in via the ``?exemplars=1`` query parameter, which
+        no stock scraper sends; ``parse_prometheus(return_exemplars=True)``
+        is the matching consumer."""
+        opts = urllib.parse.parse_qs(query or "")
+        if opts.get("exemplars", ["0"])[-1].lower() in ("1", "true"):
+            return (self.render_prometheus(exemplars=True).encode("utf-8"),
+                    EXEMPLAR_CONTENT_TYPE)
+        return (self.render_prometheus().encode("utf-8"),
+                "text/plain; version=0.0.4")
 
-def parse_prometheus(text: str) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]:
+
+#: content type for the opt-in exemplar-bearing exposition: classic text
+#: plus OpenMetrics-style exemplar suffixes — a diagnostic format for
+#: parse_prometheus and humans, NOT claimed as application/openmetrics-text
+EXEMPLAR_CONTENT_TYPE = "text/plain; version=0.0.4; exemplars=1"
+
+
+def _scan_label_block(s: str, start: int) -> Tuple[str, int]:
+    """`s[start]` must be '{'; returns (inner blob, index past the closing
+    '}'), quote-aware so label values holding '}' or '#' can't derail the
+    scan."""
+    in_q = escaped = False
+    for i in range(start + 1, len(s)):
+        ch = s[i]
+        if escaped:
+            escaped = False
+        elif ch == "\\":
+            escaped = True
+        elif ch == '"':
+            in_q = not in_q
+        elif ch == "}" and not in_q:
+            return s[start + 1:i], i + 1
+    raise ValueError(f"unterminated label block in line: {s!r}")
+
+
+def _parse_label_blob(blob: str, raw: str) -> List[Tuple[str, str]]:
+    labels = []
+    for item in _split_labels(blob):
+        if not item:
+            continue
+        k, _, v = item.partition("=")
+        v = v.strip()
+        if not (v.startswith('"') and v.endswith('"')):
+            raise ValueError(f"unquoted label value in line: {raw!r}")
+        labels.append((k.strip(), _unescape_label(v[1:-1])))
+    return labels
+
+
+def parse_prometheus(
+    text: str, return_exemplars: bool = False
+) -> Any:
     """Parse Prometheus text exposition into {(name, ((label, value), ...)):
     value}. Covers the subset `render_prometheus` emits (and standard
     Prometheus output for it) — the scrape-parses gate in
     tests/test_bench_smoke.py uses this, so 'it renders' and 'it parses'
-    are the same check."""
+    are the same check.
+
+    OpenMetrics exemplars (``... value # {trace_id="..."} exemplar_value
+    ts``) are skipped by default — a parser that ignores them still reads
+    the base series. With ``return_exemplars=True`` the result is
+    ``(samples, exemplars)`` where exemplars maps the same series key to
+    ``{"labels": {...}, "value": float, "timestamp": float | None}`` —
+    the round-trip the exemplar tests gate on."""
     out: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    exemplars: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                    Dict[str, Any]] = {}
     for raw in text.splitlines():
         line = raw.strip()
         if not line or line.startswith("#"):
             continue
-        if "{" in line:
-            name, rest = line.split("{", 1)
-            labelblob, _, valpart = rest.rpartition("}")
-            labels = []
-            for item in _split_labels(labelblob):
-                if not item:
-                    continue
-                k, _, v = item.partition("=")
-                v = v.strip()
-                if not (v.startswith('"') and v.endswith('"')):
-                    raise ValueError(f"unquoted label value in line: {raw!r}")
-                labels.append((k.strip(), _unescape_label(v[1:-1])))
-            value = valpart.strip().split()[0]
+        brace = line.find("{")
+        space = line.find(" ")
+        if brace != -1 and (space == -1 or brace < space):
+            name = line[:brace].strip()
+            blob, end = _scan_label_block(line, brace)
+            labels = _parse_label_blob(blob, raw)
+            rest = line[end:].strip()
         else:
-            parts = line.split()
-            if len(parts) < 2:
-                raise ValueError(f"unparseable metric line: {raw!r}")
-            name, value = parts[0], parts[1]
+            name, _, rest = line.partition(" ")
+            name = name.strip()
             labels = []
-        out[(name.strip(), tuple(sorted(labels)))] = float(value)
+            rest = rest.strip()
+        if not rest:
+            raise ValueError(f"unparseable metric line: {raw!r}")
+        # the sample value never contains '#': everything after one is the
+        # (optional) exemplar
+        value_part, hash_, ex_part = rest.partition("#")
+        parts = value_part.split()
+        if not parts:
+            raise ValueError(f"unparseable metric line: {raw!r}")
+        key = (name, tuple(sorted(labels)))
+        out[key] = float(parts[0])
+        if hash_ and return_exemplars:
+            ex = ex_part.strip()
+            if not ex.startswith("{"):
+                raise ValueError(f"malformed exemplar in line: {raw!r}")
+            ex_blob, ex_end = _scan_label_block(ex, 0)
+            ex_fields = ex[ex_end:].split()
+            if not ex_fields:
+                raise ValueError(f"exemplar missing value in line: {raw!r}")
+            exemplars[key] = {
+                "labels": dict(_parse_label_blob(ex_blob, raw)),
+                "value": float(ex_fields[0]),
+                "timestamp": (
+                    float(ex_fields[1]) if len(ex_fields) > 1 else None
+                ),
+            }
+    if return_exemplars:
+        return out, exemplars
     return out
 
 

@@ -1,0 +1,424 @@
+"""Device-utilization profiler: cost-model MFU accounting, sampled device
+timing, and a bounded per-dispatch flight recorder.
+
+The ROADMAP's central open problem is that the hardware is mostly idle
+(6-27% MFU in the bench artifacts) — but utilization was only measurable
+*offline*, by bench.py dividing hand-counted FLOPs by wall-clock. This
+module makes device efficiency a continuous runtime observable, wired
+through the dispatch hot path (core/dispatch.py + models/tpu_model.py)
+rather than bolted onto benchmarks:
+
+- **Cost-model capture.** When the dispatch cache AOT-compiles a program
+  (``jit(...).lower(...).compile()``), it reports the compile wall time and
+  the harvested ``compiled.cost_analysis()`` (flops, bytes accessed) here,
+  per program key — ``dispatch_compile_seconds{site}`` histogram plus a
+  bounded cost table. ``Network.flops_per_example()`` is the documented
+  fallback/cross-check when XLA's cost model is unavailable on a backend
+  (callers pass it as ``fallback_flops``).
+- **Sampled device timing.** ``should_sample()`` is a 1-in-N gate: sampled
+  dispatches block until ready and report real device wall time; off-sample
+  dispatches stay fully async. Samples feed rolling ``device_mfu{model}``,
+  ``device_flops_per_sec{model}`` and ``device_arithmetic_intensity{model}``
+  gauges against the per-backend peak-FLOPs table in core/env.py, plus a
+  ``dispatch_device_seconds{site}`` histogram.
+- **Flight recorder.** Every profiled dispatch appends a bounded ring
+  record (program key, bucket signature, queue -> dispatch -> done
+  timestamps, flops, bytes, donation/cache-hit/compile flags, active trace
+  id). ``GET /debug/flight`` on every server serves ``flight()`` as JSON,
+  so a live production pause is diagnosable without redeploying.
+- **Compile-storm detection.** More than ``storm_threshold`` fresh compiles
+  attributed to one trace (or, untraced, one thread within a short window)
+  means ragged traffic escaped the power-of-two buckets: ONE structured
+  warning with the offending signatures + ``dispatch_compile_storms_total``.
+
+Rollback parity: everything here no-ops under ``obs.set_enabled(False)`` /
+``obs.disabled()`` exactly like the PR 5 metrics do — ``enabled`` mirrors
+the registry switch, so the overhead bench's baseline arm pays zero
+profiler cost (gated <= 5% by bench.run_profiler_smoke, BENCH_pr13.json).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from mmlspark_tpu.obs.logging import get_logger
+from mmlspark_tpu.obs.metrics import registry
+from mmlspark_tpu.obs.tracing import _epoch, current_span
+
+__all__ = [
+    "DeviceProfiler",
+    "device_profiler",
+    "profiler_sampling",
+]
+
+log = get_logger("mmlspark_tpu.obs")
+
+#: default 1-in-N device-timing sample rate (config: obs.profiler.sample_every)
+DEFAULT_SAMPLE_EVERY = 32
+#: flight-recorder ring capacity (records, not bytes; each is a small dict)
+DEFAULT_MAX_RECORDS = 1024
+#: fresh compiles per trace/thread-window before a storm warning fires
+DEFAULT_STORM_THRESHOLD = 8
+#: untraced storm attribution window: compiles on one thread separated by
+#: more than this are different "requests"
+_STORM_GAP_S = 5.0
+#: rolling MFU window length (sampled dispatches per model label)
+_MFU_WINDOW = 256
+
+
+class DeviceProfiler:
+    """Process-wide device-efficiency meters; one instance per process
+    (``device_profiler()``), mirroring the metrics registry it reports
+    into. Thread-safe; every public method is a no-op while the
+    observability layer is disabled."""
+
+    def __init__(self, sample_every: Optional[int] = None,
+                 max_records: int = DEFAULT_MAX_RECORDS,
+                 storm_threshold: Optional[int] = None):
+        from mmlspark_tpu.core.config import get as _cfg_get
+
+        if sample_every is None:
+            sample_every = int(
+                _cfg_get("obs.profiler.sample.every", DEFAULT_SAMPLE_EVERY)
+            )
+        if storm_threshold is None:
+            storm_threshold = int(
+                _cfg_get("obs.profiler.storm.threshold",
+                         DEFAULT_STORM_THRESHOLD)
+            )
+        self._lock = threading.Lock()
+        self._sample_every = max(0, int(sample_every))
+        self._seq = itertools.count()
+        self._records: "deque[Dict[str, Any]]" = deque(maxlen=max_records)
+        self._total_records = 0
+        # program cost table: (key, signature) -> {"flops", "bytes",
+        # "compile_s"}; bounded so a churning model mix can't grow it forever
+        self._costs: "OrderedDict[Tuple[Any, Any], Dict[str, float]]" = (
+            OrderedDict()
+        )
+        self._max_costs = 256
+        # rolling per-model windows: label -> deque[(flops, bytes, secs)]
+        self._windows: Dict[str, "deque"] = {}
+        self.storm_threshold = max(1, int(storm_threshold))
+        self._storms: "OrderedDict[Any, Dict[str, Any]]" = OrderedDict()
+        self._peak: Optional[float] = None  # resolved lazily (imports jax)
+
+        reg = registry()
+        self._compile_hist = reg.histogram(
+            "dispatch_compile_seconds",
+            "XLA AOT compile wall seconds per dispatch site",
+            ("site",),
+        )
+        self._device_hist = reg.histogram(
+            "dispatch_device_seconds",
+            "Sampled device wall seconds per dispatch site",
+            ("site",),
+        )
+        self._mfu_gauge = reg.gauge(
+            "device_mfu",
+            "Rolling model-FLOPs utilization (0-1) over sampled dispatches",
+            ("model",),
+        )
+        self._fps_gauge = reg.gauge(
+            "device_flops_per_sec",
+            "Rolling device FLOP/s over sampled dispatches",
+            ("model",),
+        )
+        self._ai_gauge = reg.gauge(
+            "device_arithmetic_intensity",
+            "Rolling flops per byte accessed (cost model) over sampled "
+            "dispatches",
+            ("model",),
+        )
+        self._sampled_total = reg.counter(
+            "dispatch_sampled_total",
+            "Dispatches that paid the block-until-ready device timing",
+        )
+        self._storm_total = reg.counter(
+            "dispatch_compile_storms_total",
+            "Requests/transforms that triggered more than the storm "
+            "threshold of fresh XLA compiles",
+        )
+        self._flight_total = reg.counter(
+            "flight_records_total",
+            "Per-dispatch flight-recorder records written (ring-bounded "
+            "retention; this counter is the monotonic total)",
+        )
+
+    # -- enable/disable (mirrors obs.set_enabled) ------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return registry().enabled
+
+    # -- sampling --------------------------------------------------------------
+
+    @property
+    def sample_every(self) -> int:
+        return self._sample_every
+
+    def set_sample_every(self, n: int) -> None:
+        """1-in-N device-timing rate; 0 disables sampling (dispatches stay
+        fully async), 1 times every dispatch (bench mode)."""
+        self._sample_every = max(0, int(n))
+
+    def should_sample(self) -> bool:
+        """True when THIS dispatch should pay a block_until_ready to
+        measure device time. Counter-based 1-in-N (not random: overhead is
+        deterministic and testable); always False while obs is disabled or
+        sampling is off."""
+        n = self._sample_every
+        if n <= 0 or not self.enabled:
+            return False
+        return next(self._seq) % n == 0
+
+    # -- cost-model capture ----------------------------------------------------
+
+    def note_compile(self, key: Any, signature: Any, site: str,
+                     seconds: float, cost: Optional[Dict[str, float]]) -> None:
+        """One fresh XLA compile at `site`: wall time into the histogram,
+        harvested cost model (``{"flops", "bytes"}``, either may be absent)
+        into the per-program table, storm accounting bumped."""
+        if not self.enabled:
+            return
+        self._compile_hist.labels(site=site).observe(float(seconds))
+        entry = {"compile_s": float(seconds)}
+        if cost:
+            if cost.get("flops") is not None:
+                entry["flops"] = float(cost["flops"])
+            if cost.get("bytes") is not None:
+                entry["bytes"] = float(cost["bytes"])
+        with self._lock:
+            self._costs[(key, signature)] = entry
+            while len(self._costs) > self._max_costs:
+                self._costs.popitem(last=False)
+        self._note_storm(site, signature)
+
+    def cost_for(self, key: Any, signature: Any) -> Optional[Dict[str, float]]:
+        """The harvested cost-model entry for a program, or None when the
+        backend's cost model was unavailable (callers fall back to analytic
+        FLOPs — Network.flops_per_example)."""
+        with self._lock:
+            return self._costs.get((key, signature))
+
+    def _note_storm(self, site: str, signature: Any) -> None:
+        span = current_span()
+        now = time.monotonic()
+        if span is not None and span.recording:
+            group: Any = ("trace", span.trace_id)
+            trace_id: Optional[str] = span.trace_id
+        else:
+            group = ("thread", threading.get_ident())
+            trace_id = None
+        with self._lock:
+            st = self._storms.get(group)
+            if st is None or (
+                group[0] == "thread" and now - st["last"] > _STORM_GAP_S
+            ):
+                st = {"count": 0, "signatures": [], "warned": False,
+                      "last": now}
+                self._storms[group] = st
+                while len(self._storms) > 128:
+                    self._storms.popitem(last=False)
+            st["count"] += 1
+            st["last"] = now
+            if len(st["signatures"]) < 16:
+                st["signatures"].append(_jsonable_sig(signature))
+            storm = st["count"] > self.storm_threshold and not st["warned"]
+            if storm:
+                st["warned"] = True
+                count, sigs = st["count"], list(st["signatures"])
+        if storm:
+            self._storm_total.inc()
+            log.warning(
+                "compile_storm",
+                site=site,
+                compiles=count,
+                threshold=self.storm_threshold,
+                signatures=sigs,
+                trace_id=trace_id,
+            )
+
+    # -- dispatch recording ----------------------------------------------------
+
+    def record_dispatch(self, *, site: str, model: str, key: Any,
+                        signature: Any, rows: int,
+                        t_queue: float, t_dispatch: float,
+                        device_s: Optional[float] = None,
+                        fallback_flops: Optional[float] = None,
+                        donated: bool = False,
+                        first_compile: bool = False) -> None:
+        """One device dispatch: a flight-recorder record always (while
+        enabled), MFU/intensity gauge updates when `device_s` was sampled.
+        Timestamps are time.monotonic() readings; the flight export maps
+        them to epoch through the tracer's wall anchor."""
+        if not self.enabled:
+            return
+        cost = self.cost_for(key, signature)
+        flops = cost.get("flops") if cost else None
+        nbytes = cost.get("bytes") if cost else None
+        flops_src = "cost_model"
+        if flops is None and fallback_flops is not None:
+            flops = float(fallback_flops)
+            flops_src = "analytic"
+        span = current_span()
+        rec: Dict[str, Any] = {
+            "site": site,
+            "model": model,
+            "program": _jsonable_sig(key),
+            "signature": _jsonable_sig(signature),
+            "rows": int(rows),
+            "t_queue": round(_epoch(t_queue), 6),
+            "t_dispatch": round(_epoch(t_dispatch), 6),
+            "t_done": (
+                round(_epoch(t_dispatch + device_s), 6)
+                if device_s is not None else None
+            ),
+            "device_s": (
+                round(device_s, 6) if device_s is not None else None
+            ),
+            "sampled": device_s is not None,
+            "flops": flops,
+            "flops_source": flops_src if flops is not None else None,
+            "bytes": nbytes,
+            "donated": bool(donated),
+            "cache_hit": not first_compile,
+            "trace_id": (
+                span.trace_id if span is not None and span.recording
+                else None
+            ),
+        }
+        with self._lock:
+            self._records.append(rec)
+            self._total_records += 1
+        self._flight_total.inc()
+        if device_s is not None:
+            self._sampled_total.inc()
+            self._device_hist.labels(site=site).observe(float(device_s))
+            if flops is not None:
+                self._update_window(model, float(flops),
+                                    float(nbytes) if nbytes else 0.0,
+                                    float(device_s))
+
+    def record_device_work(self, *, site: str, model: str, seconds: float,
+                           flops: float, nbytes: float = 0.0) -> None:
+        """Aggregate device work that is not a single cached dispatch (a
+        GBDT boost phase, a training epoch): feeds the same
+        dispatch_device_seconds histogram and rolling MFU gauges. `flops`
+        is usually an analytic estimate — callers document theirs."""
+        if not self.enabled or seconds <= 0:
+            return
+        self._device_hist.labels(site=site).observe(float(seconds))
+        self._update_window(model, float(flops), float(nbytes),
+                            float(seconds))
+
+    def _update_window(self, model: str, flops: float, nbytes: float,
+                       seconds: float) -> None:
+        with self._lock:
+            win = self._windows.get(model)
+            if win is None:
+                win = self._windows[model] = deque(maxlen=_MFU_WINDOW)
+            win.append((flops, nbytes, seconds))
+            f_sum = sum(f for f, _, _ in win)
+            b_sum = sum(b for _, b, _ in win)
+            s_sum = sum(s for _, _, s in win)
+        if s_sum <= 0:
+            return
+        fps = f_sum / s_sum
+        self._fps_gauge.labels(model=model).set(fps)
+        if b_sum > 0:
+            self._ai_gauge.labels(model=model).set(f_sum / b_sum)
+        peak = self._peak_flops()
+        if peak > 0:
+            self._mfu_gauge.labels(model=model).set(fps / peak)
+
+    def _peak_flops(self) -> float:
+        if self._peak is None:
+            from mmlspark_tpu.core.env import peak_flops_per_sec
+
+            try:
+                self._peak = float(peak_flops_per_sec())
+            except Exception as e:  # backend not initializable: omit MFU
+                log.debug("peak_flops_unavailable", error=repr(e))
+                self._peak = 0.0
+        return self._peak
+
+    def mfu(self, model: str) -> float:
+        """The current rolling MFU gauge value for `model` (nan before any
+        sample)."""
+        return self._mfu_gauge.labels(model=model).value() or float("nan")
+
+    # -- flight recorder export ------------------------------------------------
+
+    def flight(self, limit: Optional[int] = None) -> Dict[str, Any]:
+        """The ``GET /debug/flight`` payload: recent per-dispatch records
+        (oldest first) plus reconciliation counters — `total_records` is
+        the monotonic count (== flight_records_total), `ring_capacity` the
+        retention bound, and `sample_every` the active timing rate."""
+        with self._lock:
+            records = list(self._records)
+            total = self._total_records
+        if limit is not None:
+            records = records[-int(limit):]
+        return {
+            "records": records,
+            "total_records": total,
+            "ring_capacity": self._records.maxlen,
+            "sample_every": self._sample_every,
+            "storm_threshold": self.storm_threshold,
+        }
+
+    def clear(self) -> None:
+        """Drop ring/cost/window state (tests); registry series persist."""
+        with self._lock:
+            self._records.clear()
+            self._total_records = 0
+            self._costs.clear()
+            self._windows.clear()
+            self._storms.clear()
+
+
+def _jsonable_sig(value: Any) -> Any:
+    """Program keys/signatures are arbitrary hashables; flatten to a JSON-
+    safe shape (tuples -> lists, everything exotic -> str). Long strings
+    (a TPUModel key embeds the whole network spec) truncate to a prefix +
+    content hash so 1024 flight records stay a bounded payload while two
+    records with the same program still compare equal."""
+    if value is None or isinstance(value, (bool, int, float)):
+        return value
+    if isinstance(value, str):
+        if len(value) <= 160:
+            return value
+        import hashlib
+
+        digest = hashlib.sha1(value.encode("utf-8")).hexdigest()[:12]
+        return f"{value[:80]}...sha1:{digest}"
+    if isinstance(value, (tuple, list)):
+        return [_jsonable_sig(v) for v in value]
+    return _jsonable_sig(str(value))
+
+
+_PROFILER = DeviceProfiler()
+
+
+def device_profiler() -> DeviceProfiler:
+    """The process-wide device profiler singleton."""
+    return _PROFILER
+
+
+@contextlib.contextmanager
+def profiler_sampling(every: int) -> Iterator[DeviceProfiler]:
+    """Scoped sample-rate override (bench/tests): ``profiler_sampling(1)``
+    times every dispatch, ``profiler_sampling(0)`` turns timing off."""
+    prof = device_profiler()
+    prev = prof.sample_every
+    prof.set_sample_every(every)
+    try:
+        yield prof
+    finally:
+        prof.set_sample_every(prev)

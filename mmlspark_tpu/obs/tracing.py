@@ -167,6 +167,12 @@ class Tracer:
         self._lock = threading.Lock()
         self._finished: "deque[Span]" = deque(maxlen=max_spans)
         self._enabled = True
+        # ring-overflow accounting: a deque with maxlen evicts SILENTLY, so
+        # a tracing consumer can't tell "no spans" from "spans rotated out".
+        # Evictions are counted per instance AND into a process counter
+        # (trace_spans_dropped_total); high_water is the retention peak.
+        self._dropped = 0
+        self._high_water = 0
 
     # -- enable/disable --------------------------------------------------------
 
@@ -199,7 +205,15 @@ class Tracer:
         if span.t_end is None:
             span.t_end = time.monotonic() if t_end is None else t_end
         with self._lock:
+            maxlen = self._finished.maxlen
+            dropped = maxlen is not None and len(self._finished) >= maxlen
             self._finished.append(span)
+            if dropped:
+                self._dropped += 1
+            if len(self._finished) > self._high_water:
+                self._high_water = len(self._finished)
+        if dropped:
+            _dropped_counter().inc()
 
     def add_span(self, name: str, parent: Optional[Span],
                  t_start: float, t_end: float,
@@ -263,6 +277,18 @@ class Tracer:
         with self._lock:
             self._finished.clear()
 
+    def summary(self) -> Dict[str, Any]:
+        """Ring health: retained/capacity, the retention high-water mark,
+        and how many finished spans overflow has evicted — the signal that
+        an export arrived too late to see the whole story."""
+        with self._lock:
+            return {
+                "finished": len(self._finished),
+                "max_spans": self._finished.maxlen,
+                "high_water": self._high_water,
+                "dropped": self._dropped,
+            }
+
     def trace_summary(self, trace_id: str) -> str:
         """'http 12.3ms -> parse 1.1ms -> score 8.0ms -> reply 0.9ms' —
         the slow-request log line (children in start order)."""
@@ -324,6 +350,24 @@ class Tracer:
         with open(path, "w", encoding="utf-8") as f:
             json.dump(trace, f)
         return len(trace["traceEvents"])
+
+
+_DROPPED = []
+
+
+def _dropped_counter():
+    """The process-wide overflow counter, resolved lazily: obs.metrics
+    imports this module at its top level, so importing it back eagerly
+    (or from Tracer.__init__, which runs during THIS module's import)
+    would deadlock the partially-initialized module graph."""
+    if not _DROPPED:
+        from mmlspark_tpu.obs.metrics import registry
+
+        _DROPPED.append(registry().counter(
+            "trace_spans_dropped_total",
+            "Finished spans evicted from a tracer ring by overflow",
+        ))
+    return _DROPPED[0]
 
 
 _TRACER = Tracer()

@@ -46,8 +46,8 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
-from mmlspark_tpu.core.config import get_logger
 from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.obs.logging import get_logger
 from mmlspark_tpu.obs import registry as obs_registry
 from mmlspark_tpu.serving.fabric import FabricConfig, ServingFabric
 from mmlspark_tpu.serving.faults import FaultInjector
@@ -250,7 +250,7 @@ class DistributedServingServer:
         except (http.client.HTTPException, ConnectionError, OSError) as e:
             self.fabric.release(idx)
             self.fabric.record_failure(idx)
-            log.warning("worker %d failed: %r", idx, e)
+            log.warning("worker_failed", worker=idx, error=repr(e))
             return None, idx
         self.fabric.release(idx)
         latency_ms = (time.monotonic() - t0) * 1e3
@@ -349,10 +349,8 @@ class DistributedServingServer:
             self.fabric.set_draining(worker_idx, True)
             drained = self.fabric.wait_drained(worker_idx, timeout)
             if not drained:
-                log.warning(
-                    "worker %d did not drain in time; swapping anyway",
-                    worker_idx,
-                )
+                log.warning("worker_drain_timeout", worker=worker_idx,
+                            action="swapping anyway")
             old = self.workers[worker_idx]
             self.workers[worker_idx] = replacement
             self._conn_gen[worker_idx] += 1
@@ -366,8 +364,8 @@ class DistributedServingServer:
             )
             old.stop()
             log.info(
-                "worker %d hot-swapped (port %s -> %s)",
-                worker_idx, old.port, replacement.port,
+                "worker_hot_swapped", worker=worker_idx,
+                old_port=old.port, new_port=replacement.port,
             )
             return replacement
 
@@ -398,7 +396,9 @@ class DistributedServingServer:
             disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):
-                log.debug("gateway %s " + fmt, self.address_string(), *args)
+                log.debug("gateway_http_access",
+                          client=self.address_string(),
+                          line=(fmt % args) if args else fmt)
 
             def _send_body(self, code: int, reason: str, payload: bytes,
                            content_type: str,
@@ -423,17 +423,40 @@ class DistributedServingServer:
                 # the gateway serves the shared registry directly and
                 # aggregates per-worker liveness (docs/observability.md)
                 if route == "/metrics":
-                    self._send_body(
-                        200, "OK",
-                        obs_registry().render_prometheus().encode("utf-8"),
-                        "text/plain; version=0.0.4",
+                    parts = self.path.split("?", 1)
+                    body, ctype = obs_registry().render_scrape(
+                        parts[1] if len(parts) > 1 else ""
                     )
+                    self._send_body(200, "OK", body, ctype)
                     return
                 if route == "/healthz":
                     code, payload = outer._healthz()
                     self._send_body(
                         code, "OK" if code == 200 else "Service Unavailable",
                         payload, "application/json",
+                    )
+                    return
+                # flight-recorder surfaces: workers share this process, so
+                # the gateway serves the shared profiler ring and tracer
+                # directly, like it does /metrics (docs/observability.md)
+                if route == "/debug/flight":
+                    from mmlspark_tpu.obs.profiler import device_profiler
+
+                    self._send_body(
+                        200, "OK",
+                        json.dumps(device_profiler().flight(),
+                                   sort_keys=True).encode("utf-8"),
+                        "application/json",
+                    )
+                    return
+                if route == "/debug/trace":
+                    from mmlspark_tpu.obs import tracer as obs_tracer
+
+                    self._send_body(
+                        200, "OK",
+                        json.dumps(obs_tracer().chrome_trace()
+                                   ).encode("utf-8"),
+                        "application/json",
                     )
                     return
                 if route != f"/{outer.api_name}":
@@ -467,7 +490,7 @@ class DistributedServingServer:
                         self.headers.get("Content-Type"),
                     )
                 except Exception as e:  # defensive: policy must not 500 the gateway
-                    log.exception("gateway forward failed")
+                    log.exception("gateway_forward_failed")
                     status, reason = 502, "Bad Gateway"
                     ct = "application/json"
                     payload = json.dumps(
@@ -493,9 +516,9 @@ class DistributedServingServer:
             daemon=True,
         ).start()
         log.info(
-            "distributed serving %s -> %d workers (%s)",
-            self.url, len(self.workers),
-            ", ".join(str(w.port) for w in self.workers),
+            "distributed_serving_started", url=self.url,
+            workers=len(self.workers),
+            ports=[w.port for w in self.workers],
         )
         return self
 

@@ -52,7 +52,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.obs.logging import get_logger
 from mmlspark_tpu.obs import registry as obs_registry
 from mmlspark_tpu.obs.metrics import QuantileSketch
 
@@ -413,7 +413,8 @@ class ServingFabric:
             try:
                 w._health_ok = bool(w.health_fn())
             except Exception as e:  # a dead health probe IS unhealthiness
-                log.debug("worker %d health probe failed: %r", w.idx, e)
+                log.debug("health_probe_failed", worker=w.idx,
+                          error=repr(e))
                 w._health_ok = False
             if not w._health_ok and w.unroutable_at is None:
                 w.unroutable_at = now

@@ -35,7 +35,7 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
-from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.obs.logging import get_logger
 
 log = get_logger("mmlspark_tpu.serving")
 
@@ -71,7 +71,8 @@ class FaultInjector:
             httpd.server_close()
         with self._lock:
             self._modes[idx] = ("dead", None)
-        log.info("fault: killed worker %d (port %s)", idx, worker.port)
+        log.info("fault_injected", fault="kill_worker", worker=idx,
+                 port=worker.port)
 
     # -- transport faults ------------------------------------------------------
 

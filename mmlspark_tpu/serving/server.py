@@ -71,8 +71,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from mmlspark_tpu.core.config import get_logger
 from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.obs.logging import get_logger
 from mmlspark_tpu.io.http.schema import (
     EntityData,
     HeaderData,
@@ -113,7 +113,8 @@ class _GatewayHTTPServer(http.server.ThreadingHTTPServer):
 
         exc = sys.exc_info()[1]
         if isinstance(exc, (ConnectionError, TimeoutError)):
-            log.debug("connection from %s dropped: %r", client_address, exc)
+            log.debug("connection_dropped", client=str(client_address),
+                      error=repr(exc))
             return
         super().handle_error(request, client_address)
 
@@ -568,7 +569,8 @@ class ServingServer:
             disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):  # route into our logger
-                log.debug("%s " + fmt, self.address_string(), *args)
+                log.debug("http_access", client=self.address_string(),
+                          line=(fmt % args) if args else fmt)
 
             def _read_request(self) -> HTTPRequestData:
                 length = int(self.headers.get("Content-Length") or 0)
@@ -611,10 +613,11 @@ class ServingServer:
                 # agnostic so `curl` and scrapers both just work)
                 if route == "/metrics":
                     self._drain_body()
-                    body = obs_registry().render_prometheus().encode("utf-8")
-                    self._send(
-                        HTTPResponseData.ok(body, "text/plain; version=0.0.4")
+                    parts = self.path.split("?", 1)
+                    body, ctype = obs_registry().render_scrape(
+                        parts[1] if len(parts) > 1 else ""
                     )
+                    self._send(HTTPResponseData.ok(body, ctype))
                     return
                 if route == "/healthz":
                     self._drain_body()
@@ -625,6 +628,26 @@ class ServingServer:
                         if ok
                         else _status(503, "Service Unavailable", body)
                     )
+                    return
+                # flight-recorder surfaces (docs/observability.md "Flight
+                # recorder"): recent per-dispatch records as JSON, and the
+                # tracer ring as Chrome trace_event JSON — a live pause is
+                # diagnosable without redeploying
+                if route == "/debug/flight":
+                    self._drain_body()
+                    from mmlspark_tpu.obs.profiler import device_profiler
+
+                    body = json.dumps(
+                        device_profiler().flight(), sort_keys=True
+                    ).encode("utf-8")
+                    self._send(HTTPResponseData.ok(body))
+                    return
+                if route == "/debug/trace":
+                    self._drain_body()
+                    body = json.dumps(
+                        obs_tracer().chrome_trace()
+                    ).encode("utf-8")
+                    self._send(HTTPResponseData.ok(body))
                     return
                 if route != f"/{outer.api_name}":
                     self._send(_status(404, "Not Found"))
@@ -703,7 +726,8 @@ class ServingServer:
                     name=f"serve-sync-{self._port}",
                 )
                 self._engine_thread.start()
-        log.info("serving %s (%s mode, %s engine)", self.url, self.mode, self.engine)
+        log.info("serving_started", url=self.url, mode=self.mode,
+                 engine=self.engine)
         return self
 
     def _start_pipeline(self) -> None:
@@ -826,7 +850,7 @@ class ServingServer:
                     out = self.handler(df)
             self._route_replies(out, by_id, enforce_deadline)
         except Exception as e:  # surface pipeline errors as 500s, keep serving
-            log.exception("handler failed")
+            log.exception("handler_failed")
             for ex in by_id.values():
                 self._respond_engine(
                     ex,
@@ -947,21 +971,28 @@ class ServingServer:
         `slow_request_ms`."""
         code = resp.status_line.status_code
         dt_ms = (time.monotonic() - t0) * 1e3
-        self._lat_hist.labels(engine=self._obs_label, code=str(code)).observe(
-            dt_ms
-        )
         span = ex.span
         traced = span is not None and span.recording
         if traced:
             span.set_attribute("status_code", code)
             self._tracer.end_span(span)
+        # the explicit trace_id rides as the histogram's OpenMetrics
+        # exemplar (the span has left the contextvar by now), so a p99
+        # spike on the scrape links straight to this request's trace
+        self._lat_hist.labels(engine=self._obs_label, code=str(code)).observe(
+            dt_ms,
+            trace_id=span.trace_id if traced else None,
+            span_id=span.span_id if traced else None,
+        )
         if self.slow_request_ms is not None and dt_ms >= self.slow_request_ms:
             path = (
                 self._tracer.trace_summary(span.trace_id) if traced else "untraced"
             )
             log.warning(
-                "slow request %s: %.1f ms (threshold %.0f ms): %s",
-                ex.rid, dt_ms, self.slow_request_ms, path,
+                "slow_request", request_id=ex.rid,
+                latency_ms=round(dt_ms, 1),
+                threshold_ms=self.slow_request_ms, span_path=path,
+                trace_id=span.trace_id if traced else None,
             )
 
     @contextlib.contextmanager
@@ -1157,7 +1188,7 @@ class ServingServer:
                 }
             )
         except Exception as e:
-            log.exception("parse stage failed")
+            log.exception("parse_stage_failed")
             for ex in exchanges:
                 self._respond_engine(
                     ex,
@@ -1205,7 +1236,7 @@ class ServingServer:
                                 # overlap
                                 scored = self._staged.score(work["parsed"])
                 except Exception as e:
-                    log.exception("score stage failed")
+                    log.exception("score_stage_failed")
                     err = _status(
                         500, "Internal Server Error", repr(e).encode("utf-8")
                     )
@@ -1260,7 +1291,7 @@ class ServingServer:
                     enforce_deadline=True,
                 )
         except Exception as e:
-            log.exception("reply stage failed")
+            log.exception("reply_stage_failed")
             for ex in work["exchanges"]:
                 self._respond_engine(
                     ex,

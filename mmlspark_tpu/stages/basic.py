@@ -15,7 +15,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.obs.logging import get_logger
 from mmlspark_tpu.core.dataframe import Column, DataFrame, DataType, Field
 from mmlspark_tpu.core.params import (
     ComplexParam,
@@ -177,17 +177,14 @@ class Timer(Estimator, Wrappable):
             self.set(self.stage, stage)
         self.set_params(**kwargs)
 
-    def _log(self, msg: str) -> None:
-        get_logger("mmlspark_tpu.timer").info(msg)
-
     def fit(self, df: DataFrame) -> "TimerModel":
         inner = self.get(self.stage)
         if isinstance(inner, Estimator):
             t0 = time.perf_counter()
             fitted = inner.fit(df)
-            self._log(
-                f"{type(inner).__name__}.fit took "
-                f"{time.perf_counter() - t0:.3f}s"
+            get_logger("mmlspark_tpu.timer").info(
+                "stage_timed", stage=type(inner).__name__, op="fit",
+                seconds=round(time.perf_counter() - t0, 3),
             )
         else:
             fitted = inner
@@ -212,8 +209,8 @@ class TimerModel(Model, Wrappable):
         t0 = time.perf_counter()
         out = inner.transform(df)
         get_logger("mmlspark_tpu.timer").info(
-            f"{type(inner).__name__}.transform took "
-            f"{time.perf_counter() - t0:.3f}s"
+            "stage_timed", stage=type(inner).__name__, op="transform",
+            seconds=round(time.perf_counter() - t0, 3),
         )
         return out
 

@@ -27,7 +27,7 @@ import threading
 import time
 from typing import Dict, Iterator, Optional
 
-from mmlspark_tpu.core.config import get_logger
+from mmlspark_tpu.obs.logging import get_logger
 from mmlspark_tpu.obs import metrics as _metrics
 
 log = get_logger("mmlspark_tpu.profiling")
@@ -315,7 +315,8 @@ def profile_to(logdir: str) -> Iterator[None]:
             yield
     finally:
         log.info(
-            "profile_to(%s): %.3fs traced", logdir, time.perf_counter() - t0
+            "profile_to", logdir=logdir,
+            seconds=round(time.perf_counter() - t0, 3),
         )
 
 
@@ -330,7 +331,8 @@ def annotate(name: str, **kwargs) -> Iterator[None]:
         with jax.profiler.TraceAnnotation(name, **kwargs):
             yield
     finally:
-        log.debug("annotate(%s): %.3fs", name, time.perf_counter() - t0)
+        log.debug("annotate", region=name,
+                  seconds=round(time.perf_counter() - t0, 3))
 
 
 class StageTimer:

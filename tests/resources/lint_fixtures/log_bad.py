@@ -1,0 +1,47 @@
+"""Fixture for the unstructured-log-in-library rule: direct stdlib
+logging, bare prints, and the legacy core.config.get_logger shim.
+Parsed, never imported."""
+
+import logging
+import logging as stdlog
+from logging import getLogger
+from mmlspark_tpu.core.config import get_logger  # expect[unstructured-log-in-library]
+
+from mmlspark_tpu.obs.logging import get_logger as good_logger  # clean: the structured path
+
+
+def direct_getlogger():
+    return logging.getLogger("mmlspark_tpu.bad")  # expect[unstructured-log-in-library]
+
+
+def aliased_getlogger():
+    return stdlog.getLogger("mmlspark_tpu.bad")  # expect[unstructured-log-in-library]
+
+
+def from_import_getlogger():
+    return getLogger("mmlspark_tpu.bad")  # expect[unstructured-log-in-library]
+
+
+def legacy_shim_call():
+    log = get_logger("mmlspark_tpu.bad")  # expect[unstructured-log-in-library]
+    log.info("unstructured %s", "message")
+
+
+def bare_print(rows):
+    print("scored", len(rows))  # expect[unstructured-log-in-library]
+
+
+def deliberate_stdout_surface(rows):
+    # a user-facing display method documents itself with a suppression
+    print(rows)  # graftcheck: ignore[unstructured-log-in-library]  # expect-suppressed[unstructured-log-in-library]
+
+
+def structured_logging_is_clean():
+    log = good_logger("mmlspark_tpu.good")
+    log.info("scored_batch", rows=4)  # clean
+    return log
+
+
+def methods_named_print_are_clean(report):
+    report.print()  # clean: not the builtin
+    return report.fingerprint("x")  # clean: substring, not print

@@ -300,11 +300,20 @@ class TestMetricsLogger:
 
         from mmlspark_tpu.automl.statistics import MetricsLogger
 
+        import json as _json
+
         with caplog.at_level(logging.INFO, logger="mmlspark_tpu.metrics"):
             ml = MetricsLogger("exp1")
             ml.log_metrics({"auc": 0.93, "name": "not-a-number"})
             ml.log_metrics_df(DataFrame.from_dict({"accuracy": [0.875]}))
-        text = caplog.text
-        assert "exp1/auc=0.93" in text
-        assert "exp1/accuracy=0.875" in text
-        assert "not-a-number" not in text
+        # structured JSON lines (obs/logging.py): one "metric" event per
+        # scalar, with name/value fields instead of %-format text
+        events = [
+            _json.loads(r.getMessage()) for r in caplog.records
+            if r.name == "mmlspark_tpu.metrics"
+        ]
+        by_name = {e["name"]: e["value"] for e in events
+                   if e["event"] == "metric"}
+        assert by_name["exp1/auc"] == 0.93
+        assert by_name["exp1/accuracy"] == 0.875
+        assert "not-a-number" not in caplog.text

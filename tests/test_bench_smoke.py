@@ -34,6 +34,7 @@ OUT6 = os.path.join(REPO, "BENCH_pr06.json")
 OUT7 = os.path.join(REPO, "BENCH_pr07.json")
 OUT8 = os.path.join(REPO, "BENCH_pr08.json")
 OUT9 = os.path.join(REPO, "BENCH_pr09.json")
+OUT13 = os.path.join(REPO, "BENCH_pr13.json")
 
 
 def test_smoke_bench_beats_pre_change_baseline():
@@ -399,3 +400,67 @@ def test_streaming_smoke_gates():
     assert on_disk["footprint"]["peak_ratio"] == report["footprint"][
         "peak_ratio"]
     assert on_disk["parity"]["determinism_delta"] == 0.0
+
+
+def test_profiler_smoke_gates():
+    """ISSUE 13 acceptance, through the product path (no mocks):
+
+    - sampled-profiling serving overhead <= 5% vs obs.disabled() on the
+      same TPUModel-backed staged handler (alternating best-of-2 arms per
+      the PR 5/PR 8 protocol);
+    - the runtime device_mfu gauge lands within the documented [0.5, 2.0]
+      tolerance band of bench.py's analytic MFU on the ResNet-20 forward
+      smoke (both divide by the same core/env.py peak table, so the band
+      tests the flops + device-timing accounting);
+    - GET /debug/flight on the LIVE loaded server returns parseable JSON
+      whose records carry the full dispatch schema and whose monotonic
+      total reconciles exactly with the tpu_model_dispatch_rows counter
+      over the measured window, with sampled + trace-linked records
+      present;
+    - GET /debug/trace returns valid Chrome trace_event JSON.
+
+    Wall-clock ratios on a shared CI box carry scheduler noise, so the
+    measurement retries up to 3 times and gates on any clean round; the
+    flight/trace/schema gates are structural and must hold every round."""
+    import bench
+
+    def clean(r):
+        m = r["mfu"]
+        lo, hi = m["tolerance_band"]
+        return (
+            r["profiler_overhead"]["overhead_frac"] <= 0.05
+            and lo <= m["ratio_runtime_vs_analytic"] <= hi
+        )
+
+    for attempt in range(3):
+        report = bench.run_profiler_smoke(OUT13)
+        # structural gates: every round, no retry absolution
+        fl = report["profiler_overhead"]["instrumented"]["flight"]
+        assert fl["records"] > 0, fl
+        assert fl["schema_complete"], fl
+        assert fl["window_dispatches"] == fl["window_dispatch_counter"], fl
+        assert fl["sampled_records"] > 0, fl
+        assert fl["traced_records"] > 0, fl
+        ct = report["profiler_overhead"]["instrumented"]["chrome_trace"]
+        assert ct["valid"] and ct["events"] > 0, ct
+        assert report["mfu"]["flops_source"] == "cost_model", report["mfu"]
+        if clean(report):
+            break
+
+    assert report["profiler_overhead"]["overhead_frac"] <= 0.05, (
+        report["profiler_overhead"]
+    )
+    lo, hi = report["mfu"]["tolerance_band"]
+    assert lo <= report["mfu"]["ratio_runtime_vs_analytic"] <= hi, (
+        report["mfu"]
+    )
+
+    # the artifact the driver reads
+    with open(OUT13) as f:
+        on_disk = json.load(f)
+    assert on_disk["profiler_overhead"]["overhead_frac"] == (
+        report["profiler_overhead"]["overhead_frac"]
+    )
+    assert on_disk["mfu"]["ratio_runtime_vs_analytic"] == (
+        report["mfu"]["ratio_runtime_vs_analytic"]
+    )

@@ -662,10 +662,18 @@ class TestServingObservability:
             ) as srv:
                 status, _ = _post(srv.port, "/score", {"x": [1.0, 2.0]})
                 assert status == 200
-        slow = [r for r in caplog.records if "slow request" in r.message]
+        slow = [
+            json.loads(r.getMessage()) for r in caplog.records
+            if "slow_request" in r.message
+        ]
         assert slow, "no slow-request log emitted"
-        msg = slow[0].getMessage()
-        assert "http" in msg and "ms" in msg
+        rec = slow[0]
+        assert rec["event"] == "slow_request"
+        # structured fields: the full span path plus the trace id that
+        # links the log line to its trace in the flight recorder
+        assert "http" in rec["span_path"]
+        assert rec["latency_ms"] >= 0.0
+        assert rec["trace_id"]
 
     def test_distributed_gateway_serves_obs_endpoints(self):
         from mmlspark_tpu.serving import DistributedServingServer

@@ -1,0 +1,714 @@
+"""ISSUE 13 tests: the device-utilization profiler (cost-model capture,
+sampled device timing, flight recorder, compile-storm detection), the
+exemplar-linked exposition round-trip, structured trace-correlated
+logging, tracer ring overflow accounting, and the /debug HTTP surfaces —
+all through product paths, no mocks."""
+
+import http.client
+import json
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs import device_profiler, profiler_sampling
+from mmlspark_tpu.obs.logging import get_logger
+from mmlspark_tpu.obs.metrics import parse_prometheus, registry
+from mmlspark_tpu.obs.profiler import DeviceProfiler
+from mmlspark_tpu.obs.tracing import Tracer, tracer
+
+
+def _small_model(dim=4, out=2, batch=8, tag=0):
+    import jax
+
+    from mmlspark_tpu.dnn.network import Network, NetworkBundle
+    from mmlspark_tpu.models import TPUModel
+
+    net = Network(
+        [{"kind": "dense", "units": 8}, {"kind": "dense", "units": out}],
+        (dim,),
+    )
+    bundle = NetworkBundle(net, net.init(jax.random.PRNGKey(tag)))
+    return TPUModel(bundle, input_col="x", output_col="y",
+                    mini_batch_size=batch)
+
+
+def _frame(n=13, dim=4, seed=0):
+    from mmlspark_tpu.core.dataframe import DataFrame
+
+    rng = np.random.default_rng(seed)
+    return DataFrame.from_dict(
+        {"x": rng.normal(size=(n, dim)).astype(np.float32)}
+    )
+
+
+# -- tracer ring overflow (satellite 1) ---------------------------------------
+
+
+class TestTracerOverflow:
+    def test_hammer_overflow_increments_dropped_exactly(self):
+        """200 spans through a 64-slot ring from 4 threads: exactly 136
+        evictions, counted on the instance, in summary(), and in the
+        process trace_spans_dropped_total counter."""
+        dropped_total = registry().counter("trace_spans_dropped_total")
+        before = dropped_total.value()
+        tr = Tracer(max_spans=64)
+
+        def hammer(k):
+            for i in range(50):
+                with tr.span(f"h{k}-{i}"):
+                    pass
+
+        threads = [
+            threading.Thread(target=hammer, args=(k,)) for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        s = tr.summary()
+        assert s["finished"] == 64
+        assert s["max_spans"] == 64
+        assert s["high_water"] == 64
+        assert s["dropped"] == 200 - 64
+        assert dropped_total.value() - before == 200 - 64
+
+    def test_no_overflow_no_drop(self):
+        tr = Tracer(max_spans=64)
+        for i in range(10):
+            with tr.span(f"s{i}"):
+                pass
+        s = tr.summary()
+        assert s["dropped"] == 0
+        assert s["high_water"] == 10
+
+
+# -- obs.disabled() rollback parity (satellite 2) -----------------------------
+
+
+class TestDisabledParity:
+    def test_profiler_fully_noops_while_disabled(self):
+        prof = device_profiler()
+        model, df = _small_model(tag=1), _frame()
+        model.transform(df)  # warm compiles outside the disabled window
+        sampled = registry().counter("dispatch_sampled_total")
+        flight_total = registry().counter("flight_records_total")
+        before = (prof.flight()["total_records"], sampled.value(),
+                  flight_total.value())
+        with obs.disabled(), profiler_sampling(1):
+            assert not prof.enabled
+            assert not prof.should_sample()
+            model.transform(df)
+            # direct record calls are no-ops too, not just unsampled
+            prof.record_device_work(site="t", model="t", seconds=1.0,
+                                    flops=1.0)
+        after = (prof.flight()["total_records"], sampled.value(),
+                 flight_total.value())
+        assert after == before
+
+    def test_no_exemplars_in_exposition_while_disabled(self):
+        hist = registry().histogram("pr13_disabled_ms", "t")
+        with tracer().span("req"):
+            hist.observe(7.0)  # exemplar attached while enabled
+        line = [
+            ln for ln in registry().render_prometheus(exemplars=True).splitlines()
+            if ln.startswith("pr13_disabled_ms_count")
+        ][0]
+        assert "# {" in line  # sanity: it renders while enabled
+        with obs.disabled():
+            line = [
+                ln for ln in registry().render_prometheus(exemplars=True).splitlines()
+                if ln.startswith("pr13_disabled_ms_count")
+            ][0]
+            assert "# {" not in line
+
+    def test_observe_attaches_no_exemplar_while_disabled(self):
+        hist = registry().histogram("pr13_disabled2_ms", "t")
+        with obs.disabled():
+            hist.observe(9.0, trace_id="explicit")  # dropped entirely
+        assert hist._default_child().exemplar() is None
+
+
+# -- compile-storm detection (satellite 3) ------------------------------------
+
+
+class TestCompileStorm:
+    def test_storm_emits_one_warning_with_shapes_and_trace(self, caplog):
+        prof = DeviceProfiler(sample_every=0, storm_threshold=3)
+        storms = registry().counter("dispatch_compile_storms_total")
+        before = storms.value()
+        with caplog.at_level(logging.WARNING, logger="mmlspark_tpu.obs"):
+            with tracer().span("ragged-request") as sp:
+                for i in range(6):  # 6 fresh compiles > threshold 3
+                    prof.note_compile(
+                        "prog", (40 + i, 16, "float32"), "tpu_model.forward",
+                        0.01, None,
+                    )
+                trace_id = sp.trace_id
+        assert storms.value() - before == 1  # warned ONCE per trace
+        warnings = [
+            json.loads(r.getMessage()) for r in caplog.records
+            if "compile_storm" in r.message
+        ]
+        assert len(warnings) == 1
+        w = warnings[0]
+        assert w["event"] == "compile_storm"
+        assert w["trace_id"] == trace_id
+        assert w["site"] == "tpu_model.forward"
+        # the offending shapes ride along — the diagnosable part
+        assert [40, 16, "float32"] in w["signatures"]
+        assert w["compiles"] > w["threshold"]
+
+    def test_under_threshold_is_silent(self, caplog):
+        prof = DeviceProfiler(sample_every=0, storm_threshold=8)
+        storms = registry().counter("dispatch_compile_storms_total")
+        before = storms.value()
+        with caplog.at_level(logging.WARNING, logger="mmlspark_tpu.obs"):
+            with tracer().span("calm-request"):
+                for i in range(4):
+                    prof.note_compile("p", (i,), "s", 0.01, None)
+        assert storms.value() == before
+        assert not [r for r in caplog.records if "compile_storm" in r.message]
+
+    def test_separate_traces_do_not_accumulate(self):
+        prof = DeviceProfiler(sample_every=0, storm_threshold=4)
+        storms = registry().counter("dispatch_compile_storms_total")
+        before = storms.value()
+        for r in range(4):  # 4 requests x 2 compiles: no single storm
+            with tracer().span(f"req-{r}"):
+                prof.note_compile("p", (r, 0), "s", 0.01, None)
+                prof.note_compile("p", (r, 1), "s", 0.01, None)
+        assert storms.value() == before
+
+
+# -- exposition round-trip edge cases (satellite 5) ---------------------------
+
+
+class TestExemplarExposition:
+    def test_exemplar_label_escaping_round_trips(self):
+        hist = registry().histogram("pr13_escape_ms", "t")
+        nasty = 'tr"ace\\with\nnewline'
+        hist.observe(3.5, trace_id=nasty, span_id='sp"an\\2')
+        text = registry().render_prometheus(exemplars=True)
+        samples, ex = parse_prometheus(text, return_exemplars=True)
+        key = ("pr13_escape_ms_count", ())
+        assert samples[key] == 1.0
+        assert ex[key]["labels"]["trace_id"] == nasty
+        assert ex[key]["labels"]["span_id"] == 'sp"an\\2'
+        assert ex[key]["value"] == 3.5
+        assert ex[key]["timestamp"] is not None
+
+    def test_exemplar_on_sketch_backed_histogram_is_max_recent(self):
+        """The sketch compacts past k observations; the exemplar must stay
+        exact (it rides its own ring, not the sketch) and point at the
+        max-valued recent trace-linked observation."""
+        hist = registry().histogram("pr13_sketch_ms", "t", sketch_k=8)
+        for i in range(100):
+            hist.observe(float(i % 10), trace_id=f"t{i}")
+        hist.observe(99.0, trace_id="spike")
+        for i in range(3):
+            hist.observe(1.0, trace_id=f"after{i}")
+        text = registry().render_prometheus(exemplars=True)
+        _, ex = parse_prometheus(text, return_exemplars=True)
+        e = ex[("pr13_sketch_ms_count", ())]
+        assert e["labels"]["trace_id"] == "spike"
+        assert e["value"] == 99.0
+
+    def test_series_with_and_without_exemplars_both_parse(self):
+        hist = registry().histogram("pr13_mixed_ms", "t", ("op",))
+        hist.labels(op="traced").observe(5.0, trace_id="abc")
+        hist.labels(op="untraced").observe(2.0)  # no active span: no exemplar
+        registry().counter("pr13_plain_total", "t").inc(3)
+        text = registry().render_prometheus(exemplars=True)
+        samples, ex = parse_prometheus(text, return_exemplars=True)
+        assert samples[("pr13_mixed_ms_count", (("op", "traced"),))] == 1.0
+        assert samples[("pr13_mixed_ms_count", (("op", "untraced"),))] == 1.0
+        assert samples[("pr13_plain_total", ())] == 3.0
+        assert ("pr13_mixed_ms_count", (("op", "traced"),)) in ex
+        assert ("pr13_mixed_ms_count", (("op", "untraced"),)) not in ex
+        assert ("pr13_plain_total", ()) not in ex
+
+    def test_plain_parser_ignores_exemplars(self):
+        """Scrape compatibility: a consumer that never asks for exemplars
+        reads identical base series off an exemplar-bearing exposition."""
+        hist = registry().histogram("pr13_compat_ms", "t")
+        hist.observe(4.0, trace_id="deadbeef")
+        text = registry().render_prometheus(exemplars=True)
+        plain = parse_prometheus(text)
+        with_ex, _ = parse_prometheus(text, return_exemplars=True)
+        assert set(plain) == set(with_ex)
+        for key, v in plain.items():  # identical values (NaN-tolerant)
+            w = with_ex[key]
+            assert v == w or (v != v and w != w), key
+        assert plain[("pr13_compat_ms_count", ())] == 1.0
+
+    def test_default_exposition_is_classic_parser_safe(self):
+        """Exemplar suffixes are OpenMetrics syntax a stock Prometheus
+        0.0.4 parser rejects, so the default render must not emit them —
+        only an explicit exemplars=True (the negotiated scrape) does."""
+        hist = registry().histogram("pr13_classic_ms", "t")
+        hist.observe(6.0, trace_id="abc123")
+        assert "# {" not in registry().render_prometheus()
+        assert "# {" in registry().render_prometheus(exemplars=True)
+
+    def test_scrape_exemplars_are_explicit_query_opt_in(self):
+        """GET /metrics stays classic for EVERY scraper — including stock
+        Prometheus, whose default Accept header advertises
+        application/openmetrics-text (our exemplar exposition is
+        OpenMetrics-style, not spec-valid, so honoring that header would
+        fail the whole default scrape). Only the explicit ?exemplars=1
+        diagnostic opt-in renders them (on the live server)."""
+        from mmlspark_tpu.obs.metrics import EXEMPLAR_CONTENT_TYPE
+        from mmlspark_tpu.serving import ServingServer
+
+        stock_prometheus_accept = (
+            "application/openmetrics-text;version=1.0.0,"
+            "text/plain;version=0.0.4;q=0.5,*/*;q=0.1"
+        )
+        with ServingServer(
+            _model_handler(), api_name="neg13", mode="micro_batch"
+        ) as srv:
+            status, _ = _post(srv.port, "/neg13", {"x": [1.0] * 4})
+            assert status == 200
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=15)
+            conn.request("GET", "/metrics",
+                         headers={"Accept": stock_prometheus_accept})
+            r = conn.getresponse()
+            classic_ct, classic = r.getheader("Content-Type"), r.read()
+            conn.request("GET", "/metrics?exemplars=1")
+            r = conn.getresponse()
+            ex_ct, ex = r.getheader("Content-Type"), r.read()
+            conn.close()
+        assert classic_ct == "text/plain; version=0.0.4"
+        assert b"# {" not in classic
+        assert ex_ct == EXEMPLAR_CONTENT_TYPE
+        assert b"# {" in ex  # the latency histogram carries an exemplar
+        parse_prometheus(ex.decode())  # and still round-trips
+
+
+# -- structured logging -------------------------------------------------------
+
+
+class TestStructuredLogging:
+    def _records(self, caplog, logger_name):
+        return [
+            json.loads(r.getMessage()) for r in caplog.records
+            if r.name == logger_name
+        ]
+
+    def test_json_line_with_fields(self, caplog):
+        log = get_logger("mmlspark_tpu.t13")
+        with caplog.at_level(logging.INFO, logger="mmlspark_tpu.t13"):
+            log.info("thing_happened", rows=4, ratio=0.5, name="x")
+        (rec,) = self._records(caplog, "mmlspark_tpu.t13")
+        assert rec["event"] == "thing_happened"
+        assert rec["level"] == "INFO"
+        assert rec["logger"] == "mmlspark_tpu.t13"
+        assert rec["rows"] == 4 and rec["ratio"] == 0.5 and rec["name"] == "x"
+        assert rec["ts"] > 0
+
+    def test_active_span_stamps_trace_ids(self, caplog):
+        log = get_logger("mmlspark_tpu.t13")
+        with caplog.at_level(logging.INFO, logger="mmlspark_tpu.t13"):
+            with tracer().span("op") as sp:
+                log.info("inside_span")
+            log.info("outside_span")
+        recs = self._records(caplog, "mmlspark_tpu.t13")
+        inside = next(r for r in recs if r["event"] == "inside_span")
+        outside = next(r for r in recs if r["event"] == "outside_span")
+        assert inside["trace_id"] == sp.trace_id
+        assert inside["span_id"] == sp.span_id
+        assert "trace_id" not in outside
+
+    def test_explicit_trace_id_wins_over_context(self, caplog):
+        log = get_logger("mmlspark_tpu.t13")
+        with caplog.at_level(logging.INFO, logger="mmlspark_tpu.t13"):
+            with tracer().span("op"):
+                log.info("handed_off", trace_id="explicit-id")
+        (rec,) = self._records(caplog, "mmlspark_tpu.t13")
+        assert rec["trace_id"] == "explicit-id"
+
+    def test_exception_carries_traceback(self, caplog):
+        log = get_logger("mmlspark_tpu.t13")
+        with caplog.at_level(logging.ERROR, logger="mmlspark_tpu.t13"):
+            try:
+                raise ValueError("boom-13")
+            except ValueError:
+                log.exception("op_failed", op="fit")
+        (rec,) = self._records(caplog, "mmlspark_tpu.t13")
+        assert rec["event"] == "op_failed"
+        assert "boom-13" in rec["exc"]
+        assert rec["op"] == "fit"
+
+    def test_non_jsonable_fields_are_reprd(self, caplog):
+        log = get_logger("mmlspark_tpu.t13")
+        with caplog.at_level(logging.INFO, logger="mmlspark_tpu.t13"):
+            log.info("odd_payload", arr=np.float32(1.5), obj=object())
+        (rec,) = self._records(caplog, "mmlspark_tpu.t13")
+        assert rec["arr"] == 1.5
+        assert "object" in rec["obj"]
+
+
+# -- cost-model capture / AOT dispatch ----------------------------------------
+
+
+class TestAotCostModel:
+    def test_aot_program_compiles_once_and_harvests_cost(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.core.dispatch import DispatchCache
+
+        cache = DispatchCache()
+        prof = device_profiler()
+        jfn = jax.jit(lambda w, x: jnp.tanh(x @ w))
+        w = jnp.ones((4, 4), jnp.float32)
+        x = jnp.ones((8, 4), jnp.float32)
+        compile_hist = registry().histogram(
+            "dispatch_compile_seconds", "", ("site",)
+        )
+        before = compile_hist.labels(site="t13.site").count()
+        sig = (8, 4, "float32")
+        p1 = cache.aot_program("k13", sig, jfn, (w, x), site="t13.site")
+        p2 = cache.aot_program("k13", sig, jfn, (w, x), site="t13.site")
+        assert p1 is not None and p2 is p1  # cached, not recompiled
+        assert compile_hist.labels(site="t13.site").count() - before == 1
+        y = p1(w, x)
+        np.testing.assert_allclose(
+            np.asarray(y), np.tanh(np.ones((8, 4)) @ np.asarray(w)),
+            rtol=1e-6,
+        )
+        cost = prof.cost_for("k13", sig)
+        assert cost is not None and cost["flops"] > 0
+        assert cost["compile_s"] > 0
+
+    def test_concurrent_first_dispatch_compiles_once(self):
+        """Single-flight: N threads racing the same (key, signature) first
+        sighting pay ONE XLA compile and ONE dispatch_compile_seconds
+        observation — the multi-replica gateway shares this cache, and a
+        startup thundering herd must not be billed as a compile storm."""
+        import jax
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.core.dispatch import DispatchCache
+
+        cache = DispatchCache()
+        compiles = []
+        inner = jax.jit(lambda x: x * 3.0)
+
+        class _SlowLower:
+            def lower(self, *args):
+                compiles.append(1)
+                time.sleep(0.05)  # widen the race window
+                return inner.lower(*args)
+
+        compile_hist = registry().histogram(
+            "dispatch_compile_seconds", "", ("site",)
+        )
+        before = compile_hist.labels(site="t13.race").count()
+        x = jnp.ones((4,), jnp.float32)
+        results = [None] * 8
+        start = threading.Barrier(8)
+
+        def dispatch(i):
+            start.wait()
+            results[i] = cache.aot_program(
+                "krace", (4, "float32"), _SlowLower(), (x,),
+                site="t13.race",
+            )
+
+        threads = [threading.Thread(target=dispatch, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(compiles) == 1
+        assert compile_hist.labels(site="t13.race").count() - before == 1
+        assert results[0] is not None
+        assert all(r is results[0] for r in results)
+
+    def test_aot_rollback_returns_none(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.core.dispatch import DispatchCache, aot
+
+        cache = DispatchCache()
+        jfn = jax.jit(lambda x: x * 2)
+        x = jnp.ones((4,), jnp.float32)
+        with aot(False):
+            assert cache.aot_program("k", (4,), jfn, (x,)) is None
+
+    def test_aot_program_retention_is_bounded(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.core.dispatch import DispatchCache
+
+        cache = DispatchCache(max_programs=4)
+        jfn = jax.jit(lambda x: x + 1)
+        for n in range(1, 8):
+            x = jnp.ones((n,), jnp.float32)
+            cache.aot_program("k", (n,), jfn, (x,))
+        assert len(cache._aot) == 4
+
+    def test_fallback_flops_used_without_cost_entry(self):
+        prof = device_profiler()
+        prof.record_dispatch(
+            site="t13.fb", model="t13fb", key="nokey", signature=(1,),
+            rows=2, t_queue=0.0, t_dispatch=0.0, device_s=0.5,
+            fallback_flops=123.0,
+        )
+        rec = prof.flight()["records"][-1]
+        assert rec["flops"] == 123.0
+        assert rec["flops_source"] == "analytic"
+
+
+# -- flight recorder + sampling -----------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_sampled_dispatches_carry_device_time(self):
+        prof = device_profiler()
+        model, df = _small_model(tag=2), _frame(n=24, seed=2)
+        with profiler_sampling(1):
+            model.transform(df)
+        recs = [
+            r for r in prof.flight()["records"]
+            if r["model"] == "tpu_model:4" and r["sampled"]
+        ]
+        assert recs
+        r = recs[-1]
+        assert r["device_s"] > 0
+        assert r["t_queue"] <= r["t_dispatch"] <= r["t_done"]
+        assert r["flops"] and r["flops_source"] == "cost_model"
+        assert r["site"] == "tpu_model.forward"
+
+    def test_off_sample_dispatches_stay_async(self):
+        prof = device_profiler()
+        model, df = _small_model(tag=3), _frame(n=8, seed=3)
+        model.transform(df)  # warm
+        with profiler_sampling(0):  # sampling off: no device timing at all
+            before = prof.flight()["total_records"]
+            model.transform(df)
+            new = [
+                r for r in prof.flight()["records"]
+                if r["model"] == "tpu_model:4"
+            ][-(prof.flight()["total_records"] - before):]
+        assert all(not r["sampled"] and r["device_s"] is None for r in new)
+
+    def test_ring_is_bounded_and_total_is_monotonic(self):
+        prof = DeviceProfiler(sample_every=0, max_records=8)
+        for i in range(20):
+            prof.record_dispatch(
+                site="t", model="t", key="k", signature=(i,), rows=1,
+                t_queue=0.0, t_dispatch=0.0,
+            )
+        fl = prof.flight()
+        assert len(fl["records"]) == 8
+        assert fl["total_records"] == 20
+        assert fl["records"][-1]["signature"] == [19]
+
+    def test_mfu_gauges_update_from_samples(self):
+        prof = device_profiler()
+        model, df = _small_model(tag=4), _frame(n=16, seed=4)
+        with profiler_sampling(1):
+            model.transform(df)
+        assert prof.mfu("tpu_model:4") > 0
+        fps = registry().gauge(
+            "device_flops_per_sec", "", ("model",)
+        ).labels(model="tpu_model:4").value()
+        assert fps > 0
+        ai = registry().gauge(
+            "device_arithmetic_intensity", "", ("model",)
+        ).labels(model="tpu_model:4").value()
+        assert ai > 0
+
+
+# -- trainer/learner device accounting ----------------------------------------
+
+
+class TestTrainingDeviceMetrics:
+    def test_gbdt_fused_records_round_seconds_and_mfu(self):
+        from mmlspark_tpu.gbdt.objectives import make_objective
+        from mmlspark_tpu.gbdt.trainer import TrainConfig, train_booster
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(256, 4))
+        y = (x[:, 0] + x[:, 1] > 0).astype(np.float64)
+        hist = registry().histogram(
+            "gbdt_round_device_seconds", "", ("engine",)
+        )
+        before = hist.labels(engine="fused").count()
+        train_booster(
+            x, y, make_objective("binary"),
+            TrainConfig(num_iterations=3, num_leaves=7, verbosity=0),
+        )
+        assert hist.labels(engine="fused").count() == before + 1
+        assert registry().gauge(
+            "device_mfu", "", ("model",)
+        ).labels(model="gbdt").value() > 0
+
+    def test_learner_epoch_device_work(self):
+        from mmlspark_tpu.core.dataframe import DataFrame
+        from mmlspark_tpu.dnn import mlp
+        from mmlspark_tpu.models import TPULearner
+
+        rng = np.random.default_rng(1)
+        feats = rng.normal(size=(64, 6)).astype(np.float32)
+        labels = (feats[:, 0] > 0).astype(np.int64)
+        df = DataFrame.from_dict({"features": feats, "label": labels})
+        hist = registry().histogram(
+            "dispatch_device_seconds", "", ("site",)
+        )
+        before = hist.labels(site="tpu_learner.epoch").count()
+        TPULearner(
+            mlp(6, [8], 2), epochs=2, batch_size=32, seed=3
+        ).fit(df)
+        assert hist.labels(site="tpu_learner.epoch").count() == before + 2
+        assert registry().gauge(
+            "device_mfu", "", ("model",)
+        ).labels(model="tpu_learner:6").value() > 0
+
+
+# -- live-server integration --------------------------------------------------
+
+
+def _post(port, route, payload):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    conn.request("POST", route, json.dumps(payload).encode(),
+                 {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+def _get(port, route):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    conn.request("GET", route)
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+def _model_handler():
+    """A staged handler whose score stage IS TPUModel.transform, so the
+    flight recorder sees real dispatches from the serving hot path."""
+    from mmlspark_tpu.core.dataframe import DataType
+    from mmlspark_tpu.serving import (
+        StagedServingHandler,
+        make_reply,
+        parse_request,
+    )
+
+    model = _small_model(tag=9)
+
+    class Staged(StagedServingHandler):
+        def parse(self, df):
+            parsed = parse_request(df, {"x": (DataType.VECTOR, 4)})
+            parsed.column("x").device_values()
+            return parsed
+
+        def score(self, df):
+            return model.transform(df)
+
+        def reply(self, df):
+            return make_reply(df, "y")
+
+    return Staged()
+
+
+class TestLiveServerIntegration:
+    def test_exemplar_resolves_to_ring_span_and_slow_log_shares_trace(
+        self, caplog
+    ):
+        """ISSUE 13 acceptance: every histogram-linked exemplar trace id
+        resolves to a span in the Tracer ring, and the slow-request
+        structured log for that request carries the SAME trace id as the
+        exemplar."""
+        from mmlspark_tpu.serving import ServingServer
+
+        with caplog.at_level(logging.WARNING, logger="mmlspark_tpu.serving"):
+            with ServingServer(
+                _model_handler(), api_name="ex13", mode="micro_batch",
+                slow_request_ms=0.0,  # every request logs its span path
+            ) as srv:
+                for i in range(4):
+                    status, _ = _post(srv.port, "/ex13",
+                                      {"x": [float(i)] * 4})
+                    assert status == 200
+                engine_label = srv._obs_label
+        text = registry().render_prometheus(exemplars=True)
+        _, exemplars = parse_prometheus(text, return_exemplars=True)
+        lat_ex = [
+            e for key, e in exemplars.items()
+            if key[0] == "serving_request_latency_ms_count"
+            and ("engine", engine_label) in key[1]
+        ]
+        assert lat_ex, "latency histogram carries no exemplar"
+        ring_traces = {s.trace_id for s in tracer().spans()}
+        slow_by_trace = {
+            json.loads(r.getMessage())["trace_id"]
+            for r in caplog.records if "slow_request" in r.message
+        }
+        for e in lat_ex:
+            tid = e["labels"]["trace_id"]
+            assert tid in ring_traces  # exemplar -> span in the ring
+            assert tid in slow_by_trace  # exemplar -> same-trace slow log
+
+    def test_debug_flight_and_trace_endpoints(self):
+        from mmlspark_tpu.serving import ServingServer
+
+        prof = device_profiler()
+        with profiler_sampling(1):
+            with ServingServer(
+                _model_handler(), api_name="fl13", mode="micro_batch",
+            ) as srv:
+                for i in range(3):
+                    status, _ = _post(srv.port, "/fl13",
+                                      {"x": [float(i)] * 4})
+                    assert status == 200
+                status, body = _get(srv.port, "/debug/flight")
+                assert status == 200
+                flight = json.loads(body)
+                assert flight["records"], flight["total_records"]
+                rec = flight["records"][-1]
+                for field in ("site", "model", "program", "signature",
+                              "rows", "t_queue", "t_dispatch", "sampled",
+                              "flops", "donated", "cache_hit", "trace_id"):
+                    assert field in rec, field
+                assert flight["total_records"] >= len(flight["records"])
+                assert flight["ring_capacity"] == prof.flight()[
+                    "ring_capacity"]
+                status, body = _get(srv.port, "/debug/trace")
+                assert status == 200
+                trace = json.loads(body)
+                assert isinstance(trace["traceEvents"], list)
+                assert trace["traceEvents"], "empty chrome trace"
+                assert all(
+                    {"name", "ph", "ts", "pid"} <= set(e)
+                    for e in trace["traceEvents"]
+                )
+
+    def test_gateway_serves_debug_endpoints(self):
+        from mmlspark_tpu.serving import DistributedServingServer
+
+        with DistributedServingServer(
+            _model_handler, n_workers=2, api_name="gw13",
+            mode="micro_batch",
+        ) as srv:
+            status, _ = _post(srv.port, "/gw13", {"x": [1.0] * 4})
+            assert status == 200
+            status, body = _get(srv.port, "/debug/flight")
+            assert status == 200
+            assert "records" in json.loads(body)
+            status, body = _get(srv.port, "/debug/trace")
+            assert status == 200
+            assert "traceEvents" in json.loads(body)

@@ -582,3 +582,53 @@ def test_package_lint_clean():
     assert not findings, "graftcheck findings:\n" + "\n".join(
         str(f) for f in findings
     )
+
+
+# -- unstructured logging -----------------------------------------------------
+
+
+def test_unstructured_log_fires_and_suppresses():
+    from mmlspark_tpu.analysis.unstructured_log import check_unstructured_log
+
+    path = os.path.join(FIXTURES, "log_bad.py")
+    findings = check_unstructured_log([path], repo_root=FIXTURES)
+    _assert_matches_markers("log_bad.py", findings)
+
+
+def test_unstructured_log_allows_structured_and_lookalikes():
+    """obs.logging.get_logger imports/calls, methods merely named print,
+    and substring lookalikes (fingerprint) must not be flagged."""
+    from mmlspark_tpu.analysis.unstructured_log import check_unstructured_log
+
+    path = os.path.join(FIXTURES, "log_bad.py")
+    findings = check_unstructured_log([path], repo_root=FIXTURES)
+    with open(path) as f:
+        clean_lines = {
+            i for i, line in enumerate(f, start=1) if "clean" in line
+        }
+    assert not {f.line for f in findings} & clean_lines
+
+
+def test_unstructured_log_exempts_obs_logging_module(tmp_path):
+    """obs/logging.py is the one module allowed to own the stdlib
+    machinery — the rule must skip it wherever the repo root lives."""
+    from mmlspark_tpu.analysis.unstructured_log import check_unstructured_log
+
+    pkg = tmp_path / "obs"
+    pkg.mkdir()
+    allowed = pkg / "logging.py"
+    allowed.write_text(
+        "import logging\n\n"
+        "def stdlib_logger(name):\n"
+        "    return logging.getLogger(name)\n"
+    )
+    other = tmp_path / "other.py"
+    other.write_text(
+        "import logging\n\n"
+        "def bad():\n"
+        "    return logging.getLogger('x')\n"
+    )
+    findings = check_unstructured_log(
+        [str(allowed), str(other)], repo_root=str(tmp_path)
+    )
+    assert {f.path for f in findings} == {"other.py"}
