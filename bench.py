@@ -47,6 +47,221 @@ N_IMAGES = 16384
 BATCH = 8192
 REPEATS = 5  # median-of-5 (round-3 verdict: best-of-3 hid tunnel variance)
 
+# -- artifact provenance + clobber guard ---------------------------------------
+# Every BENCH_*.json writer stamps a provenance block (which sha, which box,
+# how loaded) and refuses to overwrite a previously-PASSING committed
+# artifact with a round that fails that bench's own tier-1 gates — the
+# PR 8/9/13 noisy-round incident class (a casual re-run on a loaded box
+# clobbering the artifact of record with a failing measurement), fixed by
+# hand three times and now structural. `python bench.py --smoke --force`
+# is the escape hatch for intentionally recording a failing round.
+
+_FORCE_WRITE = False
+
+
+def _provenance() -> dict:
+    """Where this artifact came from: git sha, host load, core count, UTC
+    timestamp — enough to spot 'recorded on a loaded box' in review."""
+    import datetime
+    import os
+    import subprocess
+
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip() or "unknown"
+    except (OSError, subprocess.SubprocessError):
+        sha = "unknown"
+    try:
+        loadavg = [round(x, 2) for x in os.getloadavg()]
+    except OSError:
+        loadavg = [-1.0, -1.0, -1.0]
+    return {
+        "git_sha": sha,
+        "loadavg": loadavg,
+        "cpu_count": os.cpu_count(),
+        "utc": datetime.datetime.now(
+            datetime.timezone.utc
+        ).isoformat(timespec="seconds"),
+    }
+
+
+def _gate_ok(gate, report: dict) -> bool:
+    """Does `report` pass its bench's own tier-1 gates? Structural damage
+    (missing keys from an older schema) counts as failing."""
+    try:
+        return bool(gate(report))
+    except (KeyError, TypeError, IndexError, ValueError):
+        return False
+
+
+def _write_report(report: dict, out_path: str) -> dict:
+    """Stamp provenance and write `out_path` — unless that would clobber
+    an existing PASSING artifact with a round that fails its own gates
+    (the guard; --force overrides). Always returns the stamped report, so
+    callers gate on the round they measured either way."""
+    import os
+
+    report = dict(report)
+    report["provenance"] = _provenance()
+    if not out_path:
+        return report
+    gate = _BENCH_GATES.get(os.path.basename(out_path))
+    if gate is not None and not _FORCE_WRITE and os.path.exists(out_path):
+        if not _gate_ok(gate, report):
+            try:
+                with open(out_path) as f:
+                    old_ok = _gate_ok(gate, json.load(f))
+            except (OSError, ValueError):
+                old_ok = False
+            if old_ok:
+                print(json.dumps({
+                    "bench_clobber_guard": os.path.basename(out_path),
+                    "action": "kept existing passing artifact",
+                    "reason": "this round fails the bench's own tier-1 "
+                              "gates (noisy box?); re-run quiet or pass "
+                              "--force",
+                }, sort_keys=True), file=sys.stderr)
+                return report
+    # tmp + os.replace: a crash mid-write must not destroy the artifact of
+    # record (the same discipline graftcheck's non-atomic-artifact-write
+    # rule enforces in the persistence tier)
+    tmp_path = out_path + ".tmp"
+    with open(tmp_path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp_path, out_path)
+    return report
+
+
+def _gate_pr03(r):
+    chain = r["tpu_model_chain"]
+    res, base = chain["resident"], chain["baseline_host_roundtrip"]
+    srv = r["serving_ragged"]
+    b, fx = srv["bucketed_resident"], srv["baseline_fixed_pad_roundtrip"]
+    return (
+        res["h2d_transfers"] < base["h2d_transfers"]
+        and res["d2h_transfers"] < base["d2h_transfers"]
+        and res["h2d_bytes"] < base["h2d_bytes"]
+        and 0 < srv["max_programs_per_stage"] <= 8
+        and b["h2d_transfers"] < fx["h2d_transfers"]
+        and b["d2h_transfers"] < fx["d2h_transfers"]
+        and b["h2d_bytes"] < fx["h2d_bytes"]
+    )
+
+
+def _gate_pr04(r):
+    e = r["serving_engines"]
+    return (
+        e["throughput_speedup"] >= 1.3
+        and e["pipelined"]["p99_ms"] <= e["sync"]["p99_ms"]
+    )
+
+
+def _gate_pr05(r):
+    o = r["obs_overhead"]
+    return o["overhead_frac"] <= 0.05 and o["trace"]["full_span_trees"] > 0
+
+
+def _gate_pr06(r):
+    ft = r["fault_tolerance"]
+    kill, wedge = ft["kill_1_of_4"], ft["wedge_breaker"]
+    shed, swap = ft["overload_shed"], ft["replace_under_load"]
+    return (
+        kill["error_rate"] < 0.01
+        and kill["recovery_ms"] is not None
+        and kill["recovery_ms"] < 500.0
+        and kill["p99_ms"] < 1000.0
+        and wedge["breaker_tripped"]
+        and wedge["error_rate"] < 0.01
+        and wedge["p99_ms"] < 1500.0
+        and shed["shed_429"] > 0
+        and shed["p99_ratio_vs_baseline"] is not None
+        and shed["p99_ratio_vs_baseline"] <= 2.0
+        and swap["errors"] == 0
+    )
+
+
+def _gate_pr07(r):
+    pf = r["prefetch"]
+    return (
+        r["fused_prep"]["speedup"] >= 2.5
+        and r["featurize_e2e"]["speedup"] >= 1.5
+        and pf["uploads_overlapping_prev_compute"]
+        >= (pf["batches"] - 1) // 2
+        and pf["overlap_ratio"] >= 0.5
+        and pf["speedup"] >= 0.8
+        and r["bf16"]["top1_match"]
+        and r["bf16"]["rel_logit_mae"] < r["bf16"]["tolerance"]
+    )
+
+
+def _gate_pr08(r):
+    return (
+        r["learner_recovery"]["killed_mid_fit"]
+        and r["learner_recovery"]["resume_parity_delta"] == 0.0
+        and r["gbdt_recovery"]["resume_parity_delta"] == 0.0
+        and all(row["green"] for row in r["fault_matrix"].values())
+        and r["checkpoint_overhead"]["learner_overhead_frac"] <= 0.05
+        and r["checkpoint_overhead"]["gbdt_overhead_frac"] <= 0.05
+        and r["learner_recovery"]["recovery_ms"] < 1000.0
+    )
+
+
+def _gate_pr09(r):
+    return (
+        r["parity"]["determinism_delta"] == 0.0
+        and r["parity"]["max_raw_delta"] <= 1e-3
+        and r["footprint"]["peak_ratio"] <= 0.5
+        and r["transfers"]["uploads_per_visit"]
+        == float(r["transfers"]["payload_leaves"])
+        and not r["transfers"]["per_row_h2d"]
+        and r["checkpoint_compose"]["resume_identical"]
+        and r["wall_clock"]["ratio"] <= 1.3
+        and r["prefetch"]["overlap_ratio"] >= 0.8
+    )
+
+
+def _gate_pr13(r):
+    lo, hi = r["mfu"]["tolerance_band"]
+    fl = r["profiler_overhead"]["instrumented"]["flight"]
+    return (
+        r["profiler_overhead"]["overhead_frac"] <= 0.05
+        and lo <= r["mfu"]["ratio_runtime_vs_analytic"] <= hi
+        and fl["schema_complete"]
+        and fl["window_dispatches"] == fl["window_dispatch_counter"]
+    )
+
+
+def _gate_pr14(r):
+    t, s = r["trace_propagation"], r["slo"]
+    return (
+        t["cross_process_tree"]
+        and t["attempt_children"] >= 2
+        and s["fast_alert_fired"]
+        and s["healthz_degraded"]
+        and s["worker_healthz_degraded"]
+        and not s["control_alerted"]
+        and s["healthz_recovered_ok"]
+        and r["overhead"]["overhead_frac"] <= 0.05
+    )
+
+
+#: artifact basename -> that bench's own tier-1 gate (the clobber guard)
+_BENCH_GATES = {
+    "BENCH_pr03.json": _gate_pr03,
+    "BENCH_pr04.json": _gate_pr04,
+    "BENCH_pr05.json": _gate_pr05,
+    "BENCH_pr06.json": _gate_pr06,
+    "BENCH_pr07.json": _gate_pr07,
+    "BENCH_pr08.json": _gate_pr08,
+    "BENCH_pr09.json": _gate_pr09,
+    "BENCH_pr13.json": _gate_pr13,
+    "BENCH_pr14.json": _gate_pr14,
+}
+
 def peak_flops() -> float:
     """Best-effort bf16 peak for the attached chip; 0 when unknown (MFU
     lines are then omitted rather than wrong). The table itself lives in
@@ -584,11 +799,7 @@ def run_smoke(out_path: str = "BENCH_pr03.json") -> dict:
             "baseline_fixed_pad_roundtrip": fixed_pad,
         },
     }
-    if out_path:
-        with open(out_path, "w") as f:
-            json.dump(report, f, indent=1, sort_keys=True)
-            f.write("\n")
-    return report
+    return _write_report(report, out_path)
 
 
 def _closed_loop_load(port, route, n_clients, n_requests, payload_fn,
@@ -748,11 +959,7 @@ def run_serving_smoke(out_path: str = "BENCH_pr04.json") -> dict:
             ),
         },
     }
-    if out_path:
-        with open(out_path, "w") as f:
-            json.dump(report, f, indent=1, sort_keys=True)
-            f.write("\n")
-    return report
+    return _write_report(report, out_path)
 
 
 def run_obs_overhead_smoke(out_path: str = "BENCH_pr05.json") -> dict:
@@ -926,11 +1133,7 @@ def run_obs_overhead_smoke(out_path: str = "BENCH_pr05.json") -> dict:
             "trace": trace_report,
         },
     }
-    if out_path:
-        with open(out_path, "w") as f:
-            json.dump(report, f, indent=1, sort_keys=True)
-            f.write("\n")
-    return report
+    return _write_report(report, out_path)
 
 
 def run_fault_smoke(out_path: str = "BENCH_pr06.json") -> dict:
@@ -1148,11 +1351,7 @@ def run_fault_smoke(out_path: str = "BENCH_pr06.json") -> dict:
             "replace_under_load": swap_stats,
         },
     }
-    if out_path:
-        with open(out_path, "w") as f:
-            json.dump(report, f, indent=1, sort_keys=True)
-            f.write("\n")
-    return report
+    return _write_report(report, out_path)
 
 
 def run_image_prep_smoke(out_path: str = "BENCH_pr07.json") -> dict:
@@ -1409,11 +1608,7 @@ def run_image_prep_smoke(out_path: str = "BENCH_pr07.json") -> dict:
         "speedup_vs_f32": round(f32_s / bf16_s, 2),
     }
 
-    if out_path:
-        with open(out_path, "w") as f:
-            json.dump(report, f, indent=1, sort_keys=True)
-            f.write("\n")
-    return report
+    return _write_report(report, out_path)
 
 
 def run_recovery_smoke(out_path: str = "BENCH_pr08.json") -> dict:
@@ -1634,11 +1829,7 @@ def run_recovery_smoke(out_path: str = "BENCH_pr08.json") -> dict:
         },
         "fault_matrix": matrix,
     }
-    if out_path:
-        with open(out_path, "w") as f:
-            json.dump(report, f, indent=1, sort_keys=True)
-            f.write("\n")
-    return report
+    return _write_report(report, out_path)
 
 
 def run_streaming_smoke(out_path: str = "BENCH_pr09.json") -> dict:
@@ -1855,11 +2046,7 @@ def run_streaming_smoke(out_path: str = "BENCH_pr09.json") -> dict:
             "checkpoint_every": 2,
         },
     }
-    if out_path:
-        with open(out_path, "w") as f:
-            json.dump(report, f, indent=1, sort_keys=True)
-            f.write("\n")
-    return report
+    return _write_report(report, out_path)
 
 
 def run_profiler_smoke(out_path: str = "BENCH_pr13.json") -> dict:
@@ -2104,11 +2291,376 @@ def run_profiler_smoke(out_path: str = "BENCH_pr13.json") -> dict:
             "overhead_frac": round(max(0.0, 1.0 - speed_ratio), 4),
         },
     }
-    if out_path:
-        with open(out_path, "w") as f:
-            json.dump(report, f, indent=1, sort_keys=True)
-            f.write("\n")
-    return report
+    return _write_report(report, out_path)
+
+
+def run_slo_trace_smoke(out_path: str = "BENCH_pr14.json") -> dict:
+    """Fabric-tracing + SLO burn-rate smoke bench (CPU-safe; wired into
+    tier-1 via tests/test_bench_smoke.py), written to BENCH_pr14.json.
+    ISSUE 14 acceptance, through the product path (no mocks):
+
+    - **trace_propagation**: closed-loop load over a 2-worker gateway with
+      worker 0 WEDGED (accepts, never answers; the injected transport
+      raises the same socket.timeout a real unresponsive peer produces) —
+      a retried request's assembled cross-process tree (gateway root ->
+      >=2 attempt children -> worker http -> parse/score/reply) is
+      fetched BY TRACE ID from ``GET /debug/trace?trace_id=`` on the
+      gateway, and tail retention pinned the retried trace.
+    - **slo**: against a fresh healthy pool, an injected error burst
+      (handler raises -> worker 500s forwarded by the gateway) fires the
+      fast-window burn alert (`slo_burn_alerts_total{slo,window}` with
+      exemplar trace ids) and flips ``/healthz`` on the gateway AND at
+      least one worker to ``"degraded"`` (HTTP code stays 200 — a burning
+      pool is still the place to send traffic), while a latency-SLO
+      control over the same stream does not alert; once the burst stops,
+      the short window drains and health returns to ``ok`` — the
+      multi-window construction resetting promptly by design.
+    - **overhead**: tracing + SLO evaluation cost <= 5% closed-loop
+      serving throughput vs ``obs.disabled()`` (alternating best-of-2
+      arms, the PR 5/8/13 protocol).
+    """
+    import http.client
+
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.core.dataframe import DataType
+    from mmlspark_tpu.obs import tracer
+    from mmlspark_tpu.obs.metrics import registry as obs_reg
+    from mmlspark_tpu.obs.slo import BurnWindow, SLOSpec, slo_monitor
+    from mmlspark_tpu.serving import (
+        DistributedServingServer,
+        FabricConfig,
+        FaultInjector,
+        ServingServer,
+        StagedServingHandler,
+        make_reply,
+        parse_request,
+    )
+
+    def http_get(port, route):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", route)
+        r = conn.getresponse()
+        body = r.read()
+        conn.close()
+        return r.status, body
+
+    def echo_factory():
+        def handler(df):
+            parsed = parse_request(df, {"x": None})
+            vals = []
+            for v in parsed["x"]:
+                if v == "boom":  # the injected error burst's trigger
+                    raise RuntimeError("injected error burst")
+                vals.append(float(v) * 2.0)
+            return make_reply(
+                parsed.with_column(
+                    "y", np.asarray(vals, np.float64), DataType.DOUBLE
+                ),
+                "y",
+            )
+        return handler
+
+    def post(port, api, payload):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        body = json.dumps(payload).encode()
+        conn.request("POST", f"/{api}", body,
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        r.read()
+        tid = r.getheader("X-Trace-Id")
+        conn.close()
+        return r.status, tid
+
+    fast_fabric = FabricConfig(
+        failure_threshold=3, open_secs=0.3, health_interval_s=0.05,
+        backoff_base_ms=1.0, backoff_max_ms=4.0,
+    )
+    monitor = slo_monitor()
+
+    # -- (1) one trace id across the fabric under a wedged worker ------------
+    tracer().clear()
+    faults = FaultInjector()
+    with DistributedServingServer(
+        echo_factory, n_workers=2, api_name="slotrace",
+        mode="micro_batch", max_wait_ms=2.0,
+        fabric=fast_fabric, worker_timeout=0.25, fault_injector=faults,
+    ) as srv:
+        for i in range(8):  # warm: both workers touched, compiles paid
+            post(srv.port, "slotrace", {"x": 1.0})
+        # wedge the worker traffic is herding to (lowest EWMA wins the
+        # p2c tie-break), so routed requests deterministically hit the
+        # wedge, time out, and retry against the healthy worker
+        snap = srv.fabric.snapshot()["workers"]
+        wedged = min(
+            snap,
+            key=lambda w: (
+                w["ewma_ms"] if w["ewma_ms"] is not None else float("inf")
+            ),
+        )["idx"]
+        faults.wedge_worker(wedged)
+        for i in range(10):
+            post(srv.port, "slotrace", {"x": float(i)})
+        # find a retried request's trace in the shared ring, then fetch
+        # its ASSEMBLED tree over HTTP by trace id (the product surface)
+        by_trace: dict = {}
+        for s in tracer().spans():
+            by_trace.setdefault(s.trace_id, []).append(s.name)
+        retried = next(
+            (
+                tid for tid, names in by_trace.items()
+                if names.count("attempt") >= 2
+                and "gateway" in names
+                and {"http", "parse", "score", "reply"} <= set(names)
+            ),
+            None,
+        )
+        assert retried is not None, "no retried cross-process trace found"
+        code, body = http_get(
+            srv.port, f"/debug/trace?trace_id={retried}"
+        )
+        assert code == 200, code
+        tree = json.loads(body)
+        roots = tree["roots"]
+        root = roots[0] if roots else {"name": None, "children": []}
+        attempts = [
+            c for c in root.get("children", []) if c["name"] == "attempt"
+        ]
+        worker_stages: set = set()
+        for a in attempts:
+            for c in a["children"]:
+                if c["name"] == "http":
+                    worker_stages |= {g["name"] for g in c["children"]}
+        tree_report = {
+            "trace_id": retried,
+            "roots": len(roots),
+            "root_name": root.get("name"),
+            "attempt_children": len(attempts),
+            "worker_stage_names": sorted(worker_stages),
+            "cross_process_tree": bool(
+                len(roots) == 1
+                and root.get("name") == "gateway"
+                and len(attempts) >= 2
+                and {"parse", "score", "reply"} <= worker_stages
+            ),
+            "pinned_flag": tree.get("flag"),
+        }
+
+    # -- (2) SLO burn: error burst -> fast alert -> degraded -> recovered ----
+    fastw = BurnWindow("fast", short_s=1.5, long_s=6.0,
+                       burn_threshold=2.0, severity="page")
+    sloww = BurnWindow("slow", short_s=3.0, long_s=12.0,
+                       burn_threshold=1.0, severity="ticket")
+    alerts_fam = obs_reg().counter(
+        "slo_burn_alerts_total",
+        "Multi-window burn-rate alert activations per SLO",
+        ("slo", "window"),
+    )
+    spec_names = []
+    prev_interval = monitor.eval_interval_s
+    try:
+        with DistributedServingServer(
+            echo_factory, n_workers=2, api_name="sloburn",
+            mode="micro_batch", max_wait_ms=2.0, fabric=fast_fabric,
+            worker_timeout=5.0,
+        ) as srv:
+            gw_label = srv.fabric.gateway_label
+            monitor.eval_interval_s = 0.05
+            specs = [
+                SLOSpec("gw_availability", objective="availability",
+                        target=0.95, engine=gw_label,
+                        windows=(fastw, sloww), min_events=8),
+                SLOSpec("latency_control", objective="latency",
+                        target=0.95, latency_threshold_ms=60_000.0,
+                        engine=gw_label, windows=(fastw, sloww),
+                        min_events=8),
+            ] + [
+                SLOSpec(f"worker{i}_availability",
+                        objective="availability", target=0.95,
+                        engine=w._obs_label, windows=(fastw, sloww),
+                        min_events=4)
+                for i, w in enumerate(srv.workers)
+            ]
+            for spec in specs:
+                monitor.register(spec)
+                spec_names.append(spec.name)
+
+            def alert_count(slo, window="fast"):
+                return alerts_fam.labels(slo=slo, window=window).value()
+
+            before = {s: alert_count(s) for s in spec_names}
+            for _ in range(12):  # healthy baseline traffic
+                post(srv.port, "sloburn", {"x": 1.0})
+            monitor.evaluate()
+            code0, body0 = http_get(srv.port, "/healthz")
+            health_before = json.loads(body0)
+
+            burst = [
+                post(srv.port, "sloburn", {"x": "boom"})[0]
+                for _ in range(24)
+            ]
+            status_after = monitor.evaluate()
+            code1, body1 = http_get(srv.port, "/healthz")
+            health_after = json.loads(body1)
+            worker_statuses = []
+            for w in srv.workers:
+                wcode, wbody = http_get(w.port, "/healthz")
+                worker_statuses.append(
+                    (wcode, json.loads(wbody)["status"])
+                )
+            gw_alert = status_after.get("gw_availability", {})
+            exemplars = (
+                gw_alert.get("alerts", {})
+                .get("fast", {})
+                .get("exemplar_trace_ids", [])
+            )
+
+            # the burst stops; the SHORT window drains and the alert
+            # resolves — multi-window alerting resetting promptly
+            time.sleep(fastw.short_s + 0.3)
+            for _ in range(12):
+                post(srv.port, "sloburn", {"x": 1.0})
+            monitor.evaluate()
+            code2, body2 = http_get(srv.port, "/healthz")
+            health_recovered = json.loads(body2)
+
+            slo_report = {
+                "windows": {
+                    "fast": [fastw.short_s, fastw.long_s,
+                             fastw.burn_threshold],
+                    "slow": [sloww.short_s, sloww.long_s,
+                             sloww.burn_threshold],
+                },
+                "burst_500s": sum(1 for s in burst if s >= 500),
+                "healthz_before": health_before["status"],
+                "fast_alert_fired": (
+                    alert_count("gw_availability") - before["gw_availability"]
+                ) >= 1,
+                "alert_exemplar_trace_ids": len(exemplars),
+                "healthz_degraded": bool(
+                    code1 == 200 and health_after["status"] == "degraded"
+                ),
+                "worker_healthz_degraded": any(
+                    c == 200 and s == "degraded"
+                    for c, s in worker_statuses
+                ),
+                "control_alerted": (
+                    alert_count("latency_control") - before["latency_control"]
+                ) >= 1,
+                "healthz_recovered_ok": health_recovered["status"] == "ok",
+                "error_budget_remaining": status_after.get(
+                    "gw_availability", {}
+                ).get("error_budget_remaining"),
+            }
+    finally:
+        monitor.eval_interval_s = prev_interval
+        for name in spec_names:
+            monitor.unregister(name)
+
+    # -- (3) tracing + SLO evaluation overhead vs obs.disabled() -------------
+    PER_ROW_S = 3e-3
+    DIM = 16
+    N_CLIENTS = 4
+    N_REQUESTS = 20
+
+    class _SLOStaged(StagedServingHandler):
+        def __init__(self):
+            self._w = jax.device_put(
+                np.random.default_rng(0).normal(
+                    size=(DIM, DIM)
+                ).astype(np.float32)
+            )
+            self._fn = jax.jit(lambda w, x: jnp.tanh(x @ w))
+
+        def parse(self, df):
+            parsed = parse_request(df, {"x": DataType.VECTOR})
+            time.sleep(PER_ROW_S * len(df))
+            parsed.column("x").device_values()
+            return parsed
+
+        def score(self, df):
+            y = self._fn(self._w, df.column("x").device_values())
+            time.sleep(PER_ROW_S * len(df))
+            return df.with_column("y", y, DataType.VECTOR)
+
+        def reply(self, df):
+            time.sleep(PER_ROW_S * len(df))
+            return make_reply(df, "y")
+
+    def closed_loop(port, n_requests):
+        return _closed_loop_load(
+            port, "/slosmoke", N_CLIENTS, n_requests,
+            lambda cid: json.dumps({"x": [float(cid)] * DIM}).encode(),
+            errors_tag="slo smoke",
+        )
+
+    handler = _SLOStaged()  # shared: both arms reuse the same compiles
+
+    def measure(instrumented: bool):
+        ctx = contextlib.nullcontext() if instrumented else obs.disabled()
+        with ctx:
+            with ServingServer(
+                handler, api_name="slosmoke", mode="micro_batch",
+                max_batch_size=N_CLIENTS, max_wait_ms=2.0,
+            ) as srv:
+                spec = SLOSpec(
+                    f"overhead-{srv._obs_label}",
+                    objective="availability", target=0.99,
+                    engine=srv._obs_label,
+                    windows=(BurnWindow("fast", 1.0, 4.0, 14.4),),
+                )
+                monitor.register(spec)
+                prev = monitor.eval_interval_s
+                monitor.eval_interval_s = 0.05
+                tracer().set_latency_threshold_ms(250.0)
+                try:
+                    closed_loop(srv.port, 5)  # warm compiles per batch size
+                    wall, lat = closed_loop(srv.port, N_REQUESTS)
+                finally:
+                    tracer().set_latency_threshold_ms(None)
+                    monitor.eval_interval_s = prev
+                    monitor.unregister(spec.name)
+                return {
+                    "throughput_rps": round(
+                        N_CLIENTS * N_REQUESTS / wall, 1
+                    ),
+                    "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+                    "p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 3),
+                    "wall_s": round(wall, 3),
+                }
+
+    # alternating best-of-2 arms (the PR 5/8/13 protocol): a fixed order
+    # would bill cold-process warm-up to whichever arm ran first
+    rounds = [
+        measure(instrumented=True), measure(instrumented=False),
+        measure(instrumented=True), measure(instrumented=False),
+    ]
+    instrumented = max(rounds[0], rounds[2],
+                       key=lambda s: s["throughput_rps"])
+    disabled = max(rounds[1], rounds[3], key=lambda s: s["throughput_rps"])
+    speed_ratio = instrumented["throughput_rps"] / disabled["throughput_rps"]
+
+    report = {
+        "pr": 14,
+        "platform": jax.default_backend(),
+        "trace_propagation": tree_report,
+        "slo": slo_report,
+        "overhead": {
+            "workload": {
+                "clients": N_CLIENTS,
+                "requests_per_client": N_REQUESTS,
+                "per_row_host_ms": PER_ROW_S * 1e3,
+                "dim": DIM,
+            },
+            "instrumented": instrumented,
+            "disabled": disabled,
+            "throughput_ratio": round(speed_ratio, 4),
+            "overhead_frac": round(max(0.0, 1.0 - speed_ratio), 4),
+        },
+    }
+    return _write_report(report, out_path)
 
 
 def main() -> int:
@@ -2159,6 +2711,10 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--force" in sys.argv[1:]:
+        # the clobber guard's escape hatch: intentionally record a round
+        # even when it fails the bench's own tier-1 gates
+        _FORCE_WRITE = True
     if "--smoke" in sys.argv[1:]:
         print(json.dumps(run_smoke(), sort_keys=True))
         print(json.dumps(run_serving_smoke(), sort_keys=True))
@@ -2168,5 +2724,6 @@ if __name__ == "__main__":
         print(json.dumps(run_recovery_smoke(), sort_keys=True))
         print(json.dumps(run_streaming_smoke(), sort_keys=True))
         print(json.dumps(run_profiler_smoke(), sort_keys=True))
+        print(json.dumps(run_slo_trace_smoke(), sort_keys=True))
         sys.exit(0)
     sys.exit(main())

@@ -34,6 +34,8 @@ RULES: Dict[str, str] = {
     "non-monotonic-duration": "time.time() feeding a duration/deadline computation; use time.monotonic/perf_counter",
     # net-timeout family (net_timeout.py)
     "network-call-no-timeout": "HTTPConnection/socket.create_connection without timeout= blocks on a dead peer for the OS TCP default",
+    # cross-process-tracing family (cross_process.py)
+    "untraced-cross-process-call": "conn.request(...) in serving/ whose headers carry no visible traceparent injection; the trace dies at this hop — build headers with obs.tracing.inject_context",
     # atomic-write family (atomic_write.py)
     "non-atomic-artifact-write": "open(path, 'w'/'wb') on a final artifact path in a persistence module without the tmp+rename discipline; a crash mid-write destroys the previous good artifact",
     # stream-path family (full_materialize.py)

@@ -1,0 +1,151 @@
+"""Cross-process-call tracing rule: every gateway->worker send must inject.
+
+`untraced-cross-process-call` flags ``conn.request(...)`` /
+``HTTPConnection`` sends inside ``mmlspark_tpu/serving/`` whose headers
+cannot be shown to carry W3C ``traceparent`` injection — the exact
+regression class PR 14 fixed: the gateway forwarded requests with bare
+``{"Content-Type": ...}`` headers, so the worker's span tree was a
+disjoint root and "why was THIS request slow" had no one-trace answer
+(docs/observability.md "Trace propagation").
+
+A headers argument is accepted as traced when, within the enclosing
+function, it is
+
+- a dict literal containing a ``"traceparent"`` key,
+- the direct result of a call whose name contains ``inject``
+  (``inject_context(span, {...})``),
+- a name assigned from such a call, or passed as an argument to one
+  (mutating injection), or
+- a name that receives a ``["traceparent"] = ...`` subscript store.
+
+A ``.request(...)`` call with NO headers argument is always flagged (the
+default headers carry nothing). Detection is lexical over Call nodes whose
+callee's trailing name is ``request`` with at least (method, path)
+arguments — aliasing the headers dict through another variable first is
+not followed; restructure or take a justified
+``# graftcheck: ignore[untraced-cross-process-call]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Set
+
+from mmlspark_tpu.analysis.base import Finding
+
+_RULE = "untraced-cross-process-call"
+
+
+def _callee_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _is_inject_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = _callee_name(node.func)
+    return name is not None and "inject" in name.lower()
+
+
+def _dict_has_traceparent(node: ast.AST) -> bool:
+    return isinstance(node, ast.Dict) and any(
+        isinstance(k, ast.Constant) and k.value == "traceparent"
+        for k in node.keys
+    )
+
+
+def _traced_names(fn: ast.AST) -> Set[str]:
+    """Names that visibly carry traceparent injection somewhere in `fn`."""
+    traced: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and (
+            _is_inject_call(node.value) or _dict_has_traceparent(node.value)
+        ):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    traced.add(tgt.id)
+        elif isinstance(node, ast.Call) and _is_inject_call(node):
+            # mutating style: inject_context(span, headers)
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    traced.add(arg.id)
+        elif (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Subscript)
+            and isinstance(node.targets[0].value, ast.Name)
+            and isinstance(node.targets[0].slice, ast.Constant)
+            and node.targets[0].slice.value == "traceparent"
+        ):
+            traced.add(node.targets[0].value.id)
+    return traced
+
+
+def _headers_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The headers expression of a .request(method, path, body, headers)
+    call, or None when absent. http.client's signature puts headers 4th
+    positionally."""
+    for kw in call.keywords:
+        if kw.arg == "headers":
+            return kw.value
+    if len(call.args) >= 4:
+        return call.args[3]
+    return None
+
+
+def check_cross_process(
+    paths: Iterable[str], repo_root: Optional[str] = None
+) -> List[Finding]:
+    repo_root = repo_root or os.getcwd()
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, repo_root)
+        # scope traced-name resolution per enclosing function: an injected
+        # headers dict in one function says nothing about another's
+        funcs = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        covered: Set[int] = set()
+        for fn in funcs:
+            traced = _traced_names(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if id(node) in covered:
+                    continue
+                if (
+                    not isinstance(node.func, ast.Attribute)
+                    or node.func.attr != "request"
+                    or len(node.args) < 2
+                ):
+                    continue
+                covered.add(id(node))
+                if any(kw.arg is None for kw in node.keywords):
+                    continue  # **kwargs splat may carry it; don't guess
+                headers = _headers_arg(node)
+                clean = headers is not None and (
+                    _dict_has_traceparent(headers)
+                    or _is_inject_call(headers)
+                    or (isinstance(headers, ast.Name)
+                        and headers.id in traced)
+                )
+                if not clean:
+                    findings.append(Finding(
+                        _RULE, rel, node.lineno,
+                        "cross-process send without visible traceparent "
+                        "injection breaks the request's trace at this hop; "
+                        "build the headers with obs.tracing.inject_context",
+                    ))
+    return findings

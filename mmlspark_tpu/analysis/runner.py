@@ -117,6 +117,20 @@ def run_all(
         from mmlspark_tpu.analysis.net_timeout import check_net_timeout
 
         findings += check_net_timeout(package_files, repo_root=root)
+    if "untraced-cross-process-call" in enabled:
+        from mmlspark_tpu.analysis.cross_process import check_cross_process
+
+        # scoped to the serving tier: its cross-process sends are the
+        # gateway->worker hops the one-trace-id contract rides on
+        # (docs/observability.md "Trace propagation")
+        serving_prefix = os.path.join(package_name, "serving") + os.sep
+        findings += check_cross_process(
+            [
+                p for p in package_files
+                if os.path.relpath(p, root).startswith(serving_prefix)
+            ],
+            repo_root=root,
+        )
     if "non-atomic-artifact-write" in enabled:
         from mmlspark_tpu.analysis.atomic_write import check_atomic_write
 

@@ -13,10 +13,20 @@ One subsystem every layer reports into, scrapeable over HTTP
 - **Tracing** (`obs.tracing`): Dapper-style spans with ids, parent links
   and attributes. A served request's id propagates from the HTTP edge
   through parse -> score -> reply and into per-stage `PipelineModel`
-  spans; export as JSONL or Chrome trace_event (Perfetto) to line host
-  stages up against `profile_to`'s device traces.
+  spans — and ACROSS processes via W3C ``traceparent`` inject/extract, so
+  a gateway-routed request is one tree from admission through
+  retries/hedges to the worker's stages. Retention is tail-based: erred,
+  shed, retried and slow traces pin; healthy traces stay 1-in-N sampled.
+  Export as JSONL or Chrome trace_event (Perfetto) to line host stages up
+  against `profile_to`'s device traces.
+- **SLOs** (`obs.slo`): declarative availability/latency objectives over
+  the serving request stream, per-objective error-budget gauges, and
+  multi-window multi-burn-rate alerting
+  (`slo_burn_alerts_total{slo,window}`) with exemplar trace ids; a
+  page-severity burn alert degrades ``/healthz``.
 - **Liveness**: ``GET /healthz`` on a `ServingServer` reports engine thread
-  health, queue depth, in-flight batches and last-dispatch age.
+  health, queue depth, in-flight batches, last-dispatch age and per-SLO
+  status.
 - **Profiling** (`obs.profiler`): XLA cost-model MFU accounting, 1-in-N
   sampled device timing, and a bounded per-dispatch flight recorder served
   at ``GET /debug/flight`` (``GET /debug/trace`` serves the tracer ring as
@@ -50,7 +60,17 @@ from mmlspark_tpu.obs.profiler import (
     device_profiler,
     profiler_sampling,
 )
-from mmlspark_tpu.obs.tracing import Span, Tracer, current_span, tracer
+from mmlspark_tpu.obs.slo import BurnWindow, SLOMonitor, SLOSpec, slo_monitor
+from mmlspark_tpu.obs.tracing import (
+    Span,
+    SpanContext,
+    Tracer,
+    current_span,
+    extract_context,
+    format_traceparent,
+    inject_context,
+    tracer,
+)
 
 __all__ = [
     "Counter",
@@ -61,9 +81,17 @@ __all__ = [
     "parse_prometheus",
     "registry",
     "Span",
+    "SpanContext",
     "Tracer",
     "current_span",
+    "extract_context",
+    "format_traceparent",
+    "inject_context",
     "tracer",
+    "BurnWindow",
+    "SLOMonitor",
+    "SLOSpec",
+    "slo_monitor",
     "StructuredLogger",
     "get_logger",
     "DeviceProfiler",

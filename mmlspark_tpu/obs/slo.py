@@ -1,0 +1,407 @@
+"""SLO engine: declarative objectives, error budgets, multi-window
+multi-burn-rate alerts.
+
+Raw gauges answer "what is the p99 right now"; an SLO answers the question
+operators actually page on: "are we burning the error budget fast enough
+that users will notice before the month ends". This module implements the
+standard multi-window, multi-burn-rate construction (Beyer et al., *The
+Site Reliability Workbook*, ch. 5) over the same request stream the
+``serving_request_latency_ms{engine,code}`` family observes: every HTTP
+edge (`ServingServer` and the distributed gateway) reports each finished
+request into the process-wide `slo_monitor()`, and declarative `SLOSpec`s
+evaluate availability or latency-threshold objectives over it.
+
+- **Objectives.** ``availability``: a request is budget-burning when it
+  finished 5xx (or died in transport). ``latency``: additionally when it
+  exceeded ``latency_threshold_ms``. Shed 429s are deliberately NOT
+  counted against availability — shedding is the overload protection
+  doing its job and has its own counter (`serving_shed_requests_total`).
+- **Burn rate.** For a window, ``burn = error_rate / (1 - target)``:
+  burn 1 consumes exactly the budget by period end; burn 14.4 on a 99.9%
+  SLO exhausts a 30-day budget in ~2 days. An alert fires only when BOTH
+  the short and the long window of a `BurnWindow` pair exceed the
+  threshold — the long window proves it's sustained, the short window
+  resets the alert promptly once the burn stops.
+- **Surfaces.** `slo_burn_alerts_total{slo,window}` counts activations;
+  `slo_error_budget_remaining{slo}` and `slo_burn_rate{slo,window}` are
+  gauges; every activation emits ONE structured ``slo_burn_alert`` log
+  line carrying exemplar trace ids of budget-burning requests (the same
+  ids the histogram exemplars and the flight recorder carry), and
+  ``GET /healthz`` on both servers degrades to ``"degraded"`` while a
+  page-severity burn alert is active (docs/observability.md "SLOs &
+  burn-rate alerts").
+
+Everything no-ops under ``obs.set_enabled(False)`` — `observe` consults
+the metrics registry's enable flag, so the overhead bench's
+`obs.disabled()` arm measures a true zero-cost baseline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from mmlspark_tpu.obs.logging import get_logger
+from mmlspark_tpu.obs.metrics import registry
+
+__all__ = [
+    "BurnWindow",
+    "SLOSpec",
+    "SLOMonitor",
+    "slo_monitor",
+]
+
+log = get_logger("mmlspark_tpu.obs")
+
+
+@dataclass(frozen=True)
+class BurnWindow:
+    """One (short, long) window pair with its burn-rate threshold.
+    ``severity="page"`` degrades /healthz while active; ``"ticket"``
+    alerts and counts without touching health."""
+
+    name: str
+    short_s: float
+    long_s: float
+    burn_threshold: float
+    severity: str = "page"
+
+    def __post_init__(self) -> None:
+        if self.short_s <= 0 or self.long_s <= 0:
+            raise ValueError("window lengths must be > 0")
+        if self.short_s > self.long_s:
+            raise ValueError("short window must not exceed the long window")
+        if self.severity not in ("page", "ticket"):
+            raise ValueError("severity must be 'page' or 'ticket'")
+
+
+#: the SRE-workbook defaults for a 30-day budget: 5m/1h fast-burn page +
+#: 30m/6h slow-burn ticket (tests/benches substitute scaled-down windows)
+DEFAULT_WINDOWS: Tuple[BurnWindow, ...] = (
+    BurnWindow("fast", 300.0, 3600.0, 14.4, "page"),
+    BurnWindow("slow", 1800.0, 21600.0, 6.0, "ticket"),
+)
+
+
+@dataclass(frozen=True)
+class SLOSpec:
+    """One declarative objective over the serving request stream.
+
+    ``engine`` selects one HTTP edge by its metrics label (a
+    `ServingServer`'s ``engine`` label or the gateway's ``gateway``
+    label); None spans every edge in the process. ``min_events`` keeps a
+    cold window from alerting off two requests."""
+
+    name: str
+    objective: str = "availability"
+    target: float = 0.99
+    latency_threshold_ms: Optional[float] = None
+    engine: Optional[str] = None
+    windows: Tuple[BurnWindow, ...] = DEFAULT_WINDOWS
+    min_events: int = 10
+
+    def __post_init__(self) -> None:
+        if self.objective not in ("availability", "latency"):
+            raise ValueError("objective must be 'availability' or 'latency'")
+        if not 0.0 < self.target < 1.0:
+            raise ValueError("target must be in (0, 1)")
+        if self.objective == "latency" and self.latency_threshold_ms is None:
+            raise ValueError(
+                "latency objective requires latency_threshold_ms"
+            )
+        if not self.windows:
+            raise ValueError("at least one BurnWindow is required")
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.target
+
+
+class _Event:
+    """One finished request as the SLO engine sees it."""
+
+    __slots__ = ("t", "engine", "code", "latency_ms", "trace_id")
+
+    def __init__(self, t: float, engine: str, code: int,
+                 latency_ms: float, trace_id: Optional[str]):
+        self.t = t
+        self.engine = engine
+        self.code = code
+        self.latency_ms = latency_ms
+        self.trace_id = trace_id
+
+
+class SLOMonitor:
+    """Process-wide burn-rate evaluator: bounded event ring, registered
+    specs, active-alert state. `observe` is the hot path (append + an
+    interval-gated evaluation); `evaluate` recomputes every spec/window
+    and transitions alerts."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 max_events: int = 65536, eval_interval_s: float = 1.0):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._events: "deque[_Event]" = deque(maxlen=max_events)
+        self._specs: Dict[str, SLOSpec] = {}
+        #: (slo, window) -> activation info for currently-firing alerts
+        self._active: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self.eval_interval_s = eval_interval_s
+        self._last_eval = float("-inf")
+        reg = registry()
+        self._alerts_total = reg.counter(
+            "slo_burn_alerts_total",
+            "Multi-window burn-rate alert activations per SLO",
+            ("slo", "window"),
+        )
+        self._budget_gauge = reg.gauge(
+            "slo_error_budget_remaining",
+            "Fraction of the SLO error budget left over the longest window",
+            ("slo",),
+        )
+        self._burn_gauge = reg.gauge(
+            "slo_burn_rate",
+            "Short-window burn rate per SLO window pair at last evaluation",
+            ("slo", "window"),
+        )
+
+    # -- spec management -------------------------------------------------------
+
+    def register(self, spec: SLOSpec) -> SLOSpec:
+        """Add (or replace) a spec; evaluation picks it up immediately."""
+        with self._lock:
+            self._specs[spec.name] = spec
+            stale = [k for k in self._active if k[0] == spec.name]
+            for k in stale:
+                self._active.pop(k)
+        return spec
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._specs.pop(name, None)
+            for k in [k for k in self._active if k[0] == name]:
+                self._active.pop(k)
+
+    def specs(self) -> List[SLOSpec]:
+        with self._lock:
+            return list(self._specs.values())
+
+    def clear(self) -> None:
+        """Drop every spec, buffered event and active alert (metric series
+        stay — Prometheus counters survive their source)."""
+        with self._lock:
+            self._specs.clear()
+            self._events.clear()
+            self._active.clear()
+
+    # -- the observation hot path ----------------------------------------------
+
+    def observe(self, engine: str, code: int, latency_ms: float,
+                trace_id: Optional[str] = None) -> None:
+        """Record one finished request (called by every HTTP edge at the
+        same site that feeds serving_request_latency_ms). No-ops while the
+        obs layer is disabled."""
+        if not registry().enabled:
+            return
+        with self._lock:
+            # clock read under the lock: appends stay timestamp-ordered, so
+            # the evaluator's newest-to-oldest scan can stop at the window
+            # edge without skipping a concurrently-appended newer event
+            now = self._clock()
+            self._events.append(
+                _Event(now, engine, int(code), float(latency_ms), trace_id)
+            )
+            due = (
+                self._specs
+                and now - self._last_eval >= self.eval_interval_s
+            )
+            if due:
+                self._last_eval = now
+        if due:
+            self.evaluate(now)
+
+    # -- evaluation ------------------------------------------------------------
+
+    @staticmethod
+    def _classify(spec: SLOSpec, ev: _Event) -> Optional[bool]:
+        """True = budget-burning, False = good, None = excluded. The
+        availability objective burns on 5xx/transport failures; the
+        latency objective burns on slow SUCCESSES and excludes errors
+        entirely (they are availability's problem — counting them twice
+        makes a latency 'control' fire on every error burst)."""
+        errored = ev.code >= 500 or ev.code < 0
+        if spec.objective == "latency":
+            if errored:
+                return None
+            return ev.latency_ms > float(spec.latency_threshold_ms)
+        return errored
+
+    def _window_stats(
+        self, spec: SLOSpec, events: List[_Event], now: float,
+        lengths: List[float],
+    ) -> Dict[float, Tuple[int, int, List[str]]]:
+        """(total, bad, bad-trace-id exemplars) per trailing window length,
+        computed in ONE newest-to-oldest pass — each event is engine-matched
+        and classified once and folded into every window it falls in (the
+        short windows are subsets of the longest, so separate scans would
+        redo the same classification work per window)."""
+        cutoffs = [(length, now - length) for length in lengths]
+        oldest = now - max(lengths)
+        acc: Dict[float, List[Any]] = {
+            length: [0, 0, []] for length in lengths
+        }
+        for ev in reversed(events):
+            if ev.t < oldest:
+                break
+            if spec.engine is not None and ev.engine != spec.engine:
+                continue
+            verdict = self._classify(spec, ev)
+            if verdict is None:
+                continue
+            for length, cutoff in cutoffs:
+                if ev.t < cutoff:
+                    continue
+                s = acc[length]
+                s[0] += 1
+                if verdict:
+                    s[1] += 1
+                    if ev.trace_id and len(s[2]) < 5:
+                        s[2].append(ev.trace_id)
+        return {
+            length: (s[0], s[1], s[2]) for length, s in acc.items()
+        }
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Recompute every spec/window, transition alert state, update the
+        gauges; returns `status()`. Cheap at smoke scale (ONE reverse scan
+        of the bounded ring per spec, all windows folded in) and
+        interval-gated on the hot path."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            specs = list(self._specs.values())
+            events = list(self._events)
+        activated: List[Tuple[SLOSpec, BurnWindow, Dict[str, Any]]] = []
+        resolved: List[Tuple[str, str]] = []
+        for spec in specs:
+            longest = max(w.long_s for w in spec.windows)
+            lengths = {longest}
+            for w in spec.windows:
+                lengths.update((w.short_s, w.long_s))
+            stats = self._window_stats(spec, events, now, sorted(lengths))
+            total_l, bad_l, _ = stats[longest]
+            err_l = bad_l / total_l if total_l else 0.0
+            self._budget_gauge.labels(slo=spec.name).set(
+                max(0.0, 1.0 - (err_l / spec.budget))
+            )
+            for win in spec.windows:
+                t_s, b_s, ex_s = stats[win.short_s]
+                t_l, b_l, _ = stats[win.long_s]
+                burn_s = (b_s / t_s) / spec.budget if t_s else 0.0
+                burn_l = (b_l / t_l) / spec.budget if t_l else 0.0
+                self._burn_gauge.labels(
+                    slo=spec.name, window=win.name
+                ).set(round(burn_s, 4))
+                firing = (
+                    t_s >= spec.min_events
+                    and t_l >= spec.min_events
+                    and burn_s > win.burn_threshold
+                    and burn_l > win.burn_threshold
+                )
+                key = (spec.name, win.name)
+                with self._lock:
+                    was = key in self._active
+                    if firing and not was:
+                        info = {
+                            "since": now,
+                            "severity": win.severity,
+                            "burn_short": round(burn_s, 3),
+                            "burn_long": round(burn_l, 3),
+                            "threshold": win.burn_threshold,
+                            "exemplar_trace_ids": ex_s,
+                        }
+                        self._active[key] = info
+                        activated.append((spec, win, info))
+                    elif not firing and was:
+                        self._active.pop(key)
+                        resolved.append(key)
+        # alert bookkeeping outside the lock: counters + ONE structured
+        # log line per activation, carrying the burning requests' trace
+        # ids so the alert is joinable to traces/exemplars/flight records
+        for spec, win, info in activated:
+            self._alerts_total.labels(slo=spec.name, window=win.name).inc()
+            log.warning(
+                "slo_burn_alert", slo=spec.name, window=win.name,
+                severity=win.severity, objective=spec.objective,
+                target=spec.target, burn_short=info["burn_short"],
+                burn_long=info["burn_long"], threshold=win.burn_threshold,
+                exemplar_trace_ids=info["exemplar_trace_ids"],
+            )
+        for slo_name, win_name in resolved:
+            log.info("slo_burn_resolved", slo=slo_name, window=win_name)
+        return self.status()
+
+    # -- health surfaces -------------------------------------------------------
+
+    def _matches(self, spec: SLOSpec, engine: Optional[str]) -> bool:
+        return engine is None or spec.engine is None or spec.engine == engine
+
+    def status(self, engine: Optional[str] = None) -> Dict[str, Any]:
+        """Per-SLO health for /healthz: alert state per window plus the
+        budget gauge's last value. `engine` filters to specs covering that
+        edge (None = all)."""
+        with self._lock:
+            specs = [
+                s for s in self._specs.values() if self._matches(s, engine)
+            ]
+            active = dict(self._active)
+        out: Dict[str, Any] = {}
+        for spec in specs:
+            alerts = {
+                win.name: active[(spec.name, win.name)]
+                for win in spec.windows
+                if (spec.name, win.name) in active
+            }
+            out[spec.name] = {
+                "objective": spec.objective,
+                "target": spec.target,
+                "engine": spec.engine,
+                "healthy": not any(
+                    a["severity"] == "page" for a in alerts.values()
+                ),
+                "burning": sorted(alerts),
+                "alerts": alerts,
+                "error_budget_remaining": round(
+                    self._budget_gauge.labels(slo=spec.name).value(), 4
+                ),
+            }
+        return out
+
+    def page_burn_active(self, engine: Optional[str] = None) -> bool:
+        """True while any page-severity burn alert is active for a spec
+        covering `engine` — the /healthz 'degraded' trigger."""
+        with self._lock:
+            specs = {
+                s.name: s for s in self._specs.values()
+                if self._matches(s, engine)
+            }
+            return any(
+                info["severity"] == "page"
+                for (slo, _win), info in self._active.items()
+                if slo in specs
+            )
+
+
+_MONITOR: List[SLOMonitor] = []
+_MONITOR_LOCK = threading.Lock()
+
+
+def slo_monitor() -> SLOMonitor:
+    """The process-wide SLO monitor every HTTP edge reports into (lazy:
+    instrument registration must not run at import time)."""
+    if not _MONITOR:
+        with _MONITOR_LOCK:
+            if not _MONITOR:
+                _MONITOR.append(SLOMonitor())
+    return _MONITOR[0]

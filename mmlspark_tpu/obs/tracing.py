@@ -23,21 +23,51 @@ Cross-thread propagation is explicit: the serving engine hands the request
 span along in its work items and re-`activate()`s it in the worker thread.
 Within a thread, `tracer().span(...)` nests under the currently active span
 automatically (contextvars).
+
+Cross-PROCESS propagation is W3C Trace Context: `inject_context(span,
+headers)` writes a ``traceparent`` (and optional ``tracestate``) header,
+`extract_context(headers)` parses one back into a `SpanContext` that
+`start_span(context=...)` parents under — the serving gateway injects on
+every worker-bound request and `ServingServer` extracts, so one trace id
+follows a request from gateway admission through retries/hedges into the
+worker's parse/score/reply tree (docs/observability.md "Trace
+propagation"). The ``sampled`` flag rides bit 0 of the trace-flags byte so
+workers agree with the gateway's head-sampling decision.
+
+Retention is TAIL-BASED, not FIFO: the interesting traces are the rare bad
+ones, so spans whose trace erred, shed, retried, or crossed the latency
+threshold are pinned in a separate ring while healthy spans stay 1-in-N
+sampled (`set_sampling`) and rotate out first. `mark_trace` is how the
+fabric flags a trace mid-flight (retry/hedge/shed) — already-finished
+spans of that trace are promoted out of the healthy ring so the whole
+tree survives overflow.
 """
 
 from __future__ import annotations
 
 import contextlib
 import contextvars
+import itertools
 import json
 import os
+import re
 import threading
 import time
 import uuid
 from collections import deque
-from typing import Any, Dict, Iterator, List, Optional
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Mapping, Optional
 
-__all__ = ["Span", "Tracer", "tracer", "current_span"]
+__all__ = [
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "tracer",
+    "current_span",
+    "extract_context",
+    "format_traceparent",
+    "inject_context",
+]
 
 # wall-clock anchor for export: spans time with monotonic, export maps to
 # epoch as anchor_wall + (t - anchor_mono). time.time() is used ONLY as the
@@ -54,13 +84,90 @@ def _new_id() -> str:
     return uuid.uuid4().hex[:16]
 
 
+# -- W3C Trace Context (cross-process propagation) -----------------------------
+
+#: version "00" traceparent: version-traceid(32 lhex)-parentid(16 lhex)-flags
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+_SAMPLED_FLAG = 0x01
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """A remote parent extracted from ``traceparent``: enough to continue
+    the trace in this process without holding the remote Span object."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool = True
+    tracestate: Optional[str] = None
+
+
+def format_traceparent(span: Any) -> Optional[str]:
+    """The W3C ``traceparent`` value for `span`, or None while the span is
+    not recording (tracing disabled — nothing to propagate). Our 16-hex
+    trace ids are zero-padded to the wire's 32; extract strips the padding
+    back so inject -> extract round-trips to the same id."""
+    if span is None or not getattr(span, "recording", False):
+        return None
+    flags = _SAMPLED_FLAG if getattr(span, "sampled", True) else 0x00
+    return f"00-{span.trace_id:0>32}-{span.span_id:0>16}-{flags:02x}"
+
+
+def inject_context(
+    span: Any, headers: Dict[str, str],
+    tracestate: Optional[str] = None,
+) -> Dict[str, str]:
+    """Write ``traceparent`` (and a pass-through ``tracestate``) into the
+    headers dict for an outbound cross-process call; returns the same dict.
+    graftcheck's ``untraced-cross-process-call`` rule keys on this being
+    visibly applied to every gateway->worker send."""
+    tp = format_traceparent(span)
+    if tp is not None:
+        headers["traceparent"] = tp
+        if tracestate:
+            headers["tracestate"] = tracestate
+    return headers
+
+
+def extract_context(headers: Mapping[str, str]) -> Optional[SpanContext]:
+    """Parse an inbound ``traceparent`` into a SpanContext, or None when
+    the header is absent or malformed (an untraced or garbage caller must
+    never fail the request — the span just becomes a fresh root)."""
+    try:
+        raw = headers.get("traceparent")
+    except (AttributeError, TypeError):  # not a mapping: treat as absent
+        return None
+    if not raw or not isinstance(raw, str):
+        return None
+    m = _TRACEPARENT_RE.match(raw.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, span_id, flags = m.groups()
+    # all-zero ids are invalid per spec; version ff is reserved-invalid
+    if version == "ff" or set(trace_id) == {"0"} or set(span_id) == {"0"}:
+        return None
+    if trace_id.startswith("0" * 16) and set(trace_id[16:]) != {"0"}:
+        trace_id = trace_id[16:]  # our own zero-padded 16-hex ids
+    try:
+        tracestate = headers.get("tracestate")
+    except (AttributeError, TypeError):  # not a mapping: no state to carry
+        tracestate = None
+    return SpanContext(
+        trace_id, span_id,
+        sampled=bool(int(flags, 16) & _SAMPLED_FLAG),
+        tracestate=tracestate or None,
+    )
+
+
 class Span:
     """One timed, attributed operation. Mutable until `end()`; safe to hand
     across threads (attribute writes are GIL-atomic dict stores)."""
 
     __slots__ = (
         "trace_id", "span_id", "parent_id", "name", "attrs", "events",
-        "t_start", "t_end", "thread",
+        "t_start", "t_end", "thread", "sampled", "end_seq",
     )
 
     def __init__(self, name: str, trace_id: Optional[str] = None,
@@ -76,6 +183,11 @@ class Span:
         self.t_start = time.monotonic() if t_start is None else t_start
         self.t_end: Optional[float] = None
         self.thread = threading.get_ident()
+        # head-sampling verdict for HEALTHY retention (inherited from the
+        # parent / propagated context; tail pinning overrides it for
+        # interesting traces) and the tracer-assigned finish order
+        self.sampled = True
+        self.end_seq = 0
 
     @property
     def recording(self) -> bool:
@@ -129,6 +241,8 @@ class _NoopSpan:
     t_start = 0.0
     t_end = 0.0
     thread = 0
+    sampled = False
+    end_seq = 0
 
     @property
     def recording(self) -> bool:
@@ -161,18 +275,41 @@ def current_span() -> Optional[Span]:
 
 class Tracer:
     """Creates spans, tracks the active one per thread, retains finished
-    spans in a bounded ring for export."""
+    spans with tail-based priority: interesting traces (erred, shed,
+    retried, slow — flagged via `mark_trace` or self-classified at
+    `end_span`) land in a pinned ring that healthy-span overflow can never
+    evict; healthy spans stay head-sampled 1-in-N (`set_sampling`) and
+    rotate FIFO. Unsampled healthy spans wait in a small limbo ring so a
+    trace flagged LATE (the root errs after its children finished) still
+    assembles a complete tree."""
 
-    def __init__(self, max_spans: int = 8192):
+    def __init__(self, max_spans: int = 8192,
+                 max_pinned: Optional[int] = None,
+                 sample_every: int = 1,
+                 latency_threshold_ms: Optional[float] = None):
         self._lock = threading.Lock()
         self._finished: "deque[Span]" = deque(maxlen=max_spans)
+        self._pinned: "deque[Span]" = deque(
+            maxlen=max_pinned if max_pinned is not None
+            else max(64, max_spans // 4)
+        )
+        self._limbo: "deque[Span]" = deque(maxlen=max(16, max_spans // 8))
         self._enabled = True
         # ring-overflow accounting: a deque with maxlen evicts SILENTLY, so
         # a tracing consumer can't tell "no spans" from "spans rotated out".
         # Evictions are counted per instance AND into a process counter
         # (trace_spans_dropped_total); high_water is the retention peak.
         self._dropped = 0
+        self._sampled_out = 0
         self._high_water = 0
+        self._sample_every = max(1, int(sample_every))
+        self._latency_threshold_ms = latency_threshold_ms
+        self._root_count = 0
+        self._seq = itertools.count(1)
+        # interesting trace ids -> reason, bounded FIFO so always-on
+        # flagging is O(1) memory like the rings
+        self._flagged: Dict[str, str] = {}
+        self._flag_cap = 4096
 
     # -- enable/disable --------------------------------------------------------
 
@@ -183,36 +320,143 @@ class Tracer:
     def enabled(self) -> bool:
         return self._enabled
 
+    # -- retention policy knobs ------------------------------------------------
+
+    def set_sampling(self, sample_every: int) -> None:
+        """Head-sample healthy-trace retention to 1-in-N new roots (1 =
+        keep every healthy trace, the default). The verdict is stored on
+        the root span, inherited by children, and propagated cross-process
+        in the traceparent sampled flag so workers agree with the
+        gateway's decision. Interesting traces are pinned regardless."""
+        self._sample_every = max(1, int(sample_every))
+
+    @property
+    def sample_every(self) -> int:
+        return self._sample_every
+
+    def set_latency_threshold_ms(self, threshold_ms: Optional[float]) -> None:
+        """Spans at/over this duration classify their trace as interesting
+        (pinned) at end_span; None disables latency pinning."""
+        self._latency_threshold_ms = threshold_ms
+
+    def mark_trace(self, trace_id: Optional[str], reason: str = "flagged") -> None:
+        """Flag a trace as interesting mid-flight (retry, hedge, shed,
+        breaker trip): every span of it — already finished OR still open —
+        is retained in the pinned ring instead of the healthy rotation."""
+        if not self._enabled or not trace_id:
+            return
+        evicted = 0
+        with self._lock:
+            evicted = self._flag_locked(trace_id, reason)
+        for _ in range(evicted):
+            _dropped_counter().inc()
+
+    def trace_flag(self, trace_id: str) -> Optional[str]:
+        """The reason a trace was flagged, or None."""
+        with self._lock:
+            return self._flagged.get(trace_id)
+
+    # -- retention internals (caller holds the lock) ---------------------------
+
+    def _flag_locked(self, trace_id: str, reason: str) -> int:
+        if trace_id in self._flagged:
+            return 0
+        self._flagged[trace_id] = reason
+        while len(self._flagged) > self._flag_cap:
+            self._flagged.pop(next(iter(self._flagged)))
+        # promote this trace's already-finished spans out of the healthy
+        # and limbo rings so later overflow can't break up its tree
+        evicted = 0
+        for ring in (self._finished, self._limbo):
+            moved = [s for s in ring if s.trace_id == trace_id]
+            if moved:
+                kept = [s for s in ring if s.trace_id != trace_id]
+                ring.clear()
+                ring.extend(kept)
+                for s in moved:
+                    evicted += self._pin_locked(s)
+        return evicted
+
+    def _pin_locked(self, span: Span) -> int:
+        maxlen = self._pinned.maxlen
+        evicting = maxlen is not None and len(self._pinned) >= maxlen
+        if evicting:
+            self._dropped += 1
+        self._pinned.append(span)
+        return 1 if evicting else 0
+
     # -- span lifecycle --------------------------------------------------------
 
     def start_span(self, name: str, parent: Optional[Span] = None,
-                   attrs: Optional[Dict[str, Any]] = None) -> Span:
+                   attrs: Optional[Dict[str, Any]] = None,
+                   context: Optional[SpanContext] = None) -> Span:
         """Begin a span. `parent=None` nests under the context's current
         span when there is one; pass an explicit parent to propagate across
-        threads (the serving engine's path)."""
+        threads (the serving engine's path), or a `SpanContext` from
+        `extract_context` to continue a remote caller's trace (the
+        cross-process path — context wins over any local parent)."""
         if not self._enabled:
             return _NOOP
+        if context is not None:
+            span = Span(name, trace_id=context.trace_id,
+                        parent_id=context.span_id, attrs=attrs)
+            span.sampled = bool(context.sampled)
+            return span
         if parent is None:
             parent = _CURRENT.get()
         if parent is not None and parent.recording:
-            return Span(name, trace_id=parent.trace_id,
+            span = Span(name, trace_id=parent.trace_id,
                         parent_id=parent.span_id, attrs=attrs)
-        return Span(name, attrs=attrs)
+            span.sampled = parent.sampled
+            return span
+        span = Span(name, attrs=attrs)
+        span.sampled = self._sample_root()
+        return span
+
+    def _sample_root(self) -> bool:
+        if self._sample_every <= 1:
+            return True
+        with self._lock:
+            self._root_count += 1
+            return self._root_count % self._sample_every == 1
 
     def end_span(self, span: Span, t_end: Optional[float] = None) -> None:
         if not span.recording:
             return
         if span.t_end is None:
             span.t_end = time.monotonic() if t_end is None else t_end
+        # self-classification: an error attr or a duration over the
+        # threshold makes the whole TRACE interesting (tail-based), not
+        # just this span
+        reason: Optional[str] = None
+        if "error" in span.attrs:
+            reason = "error"
+        else:
+            thr = self._latency_threshold_ms
+            if thr is not None and (span.t_end - span.t_start) * 1e3 >= thr:
+                reason = "slow"
+        evicted = 0
         with self._lock:
-            maxlen = self._finished.maxlen
-            dropped = maxlen is not None and len(self._finished) >= maxlen
-            self._finished.append(span)
-            if dropped:
-                self._dropped += 1
-            if len(self._finished) > self._high_water:
-                self._high_water = len(self._finished)
-        if dropped:
+            span.end_seq = next(self._seq)
+            if reason is not None:
+                evicted += self._flag_locked(span.trace_id, reason)
+            if span.trace_id in self._flagged:
+                evicted += self._pin_locked(span)
+            elif span.sampled:
+                maxlen = self._finished.maxlen
+                if maxlen is not None and len(self._finished) >= maxlen:
+                    self._dropped += 1
+                    evicted += 1
+                self._finished.append(span)
+            else:
+                maxlen = self._limbo.maxlen
+                if maxlen is not None and len(self._limbo) >= maxlen:
+                    self._sampled_out += 1
+                self._limbo.append(span)
+            retained = len(self._finished) + len(self._pinned)
+            if retained > self._high_water:
+                self._high_water = retained
+        for _ in range(evicted):
             _dropped_counter().inc()
 
     def add_span(self, name: str, parent: Optional[Span],
@@ -228,6 +472,8 @@ class Tracer:
             parent_id=parent.span_id if parent is not None else None,
             attrs=attrs, t_start=t_start,
         )
+        if parent is not None:
+            span.sampled = parent.sampled
         self.end_span(span, t_end=t_end)
         return span
 
@@ -266,9 +512,15 @@ class Tracer:
     # -- inspection / export ---------------------------------------------------
 
     def spans(self, trace_id: Optional[str] = None) -> List[Span]:
-        """Finished spans (oldest first), optionally one trace's."""
+        """Finished retained spans in finish order (oldest first),
+        optionally one trace's — the healthy ring and the pinned ring
+        merged; limbo (unsampled, not yet flagged) spans are not
+        exported."""
         with self._lock:
-            out = list(self._finished)
+            out = sorted(
+                itertools.chain(self._finished, self._pinned),
+                key=lambda s: s.end_seq,
+            )
         if trace_id is not None:
             out = [s for s in out if s.trace_id == trace_id]
         return out
@@ -276,18 +528,54 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._finished.clear()
+            self._pinned.clear()
+            self._limbo.clear()
+            self._flagged.clear()
 
     def summary(self) -> Dict[str, Any]:
         """Ring health: retained/capacity, the retention high-water mark,
         and how many finished spans overflow has evicted — the signal that
-        an export arrived too late to see the whole story."""
+        an export arrived too late to see the whole story. `pinned` /
+        `flagged_traces` report the tail-retention side; `sampled_out`
+        counts healthy spans head-sampling let rotate out of limbo."""
         with self._lock:
             return {
                 "finished": len(self._finished),
+                "pinned": len(self._pinned),
+                "limbo": len(self._limbo),
                 "max_spans": self._finished.maxlen,
+                "max_pinned": self._pinned.maxlen,
                 "high_water": self._high_water,
                 "dropped": self._dropped,
+                "sampled_out": self._sampled_out,
+                "flagged_traces": len(self._flagged),
+                "sample_every": self._sample_every,
             }
+
+    def trace_tree(self, trace_id: str) -> Dict[str, Any]:
+        """The assembled cross-hop tree for one trace: every retained span
+        nested under its parent (spans whose parent is missing — a remote
+        hop that never reported, or rotation loss — surface as roots).
+        ``GET /debug/trace?trace_id=`` serves exactly this."""
+        spans = sorted(self.spans(trace_id), key=lambda s: s.t_start)
+        by_id: Dict[str, Dict[str, Any]] = {}
+        for s in spans:
+            d = s.to_dict()
+            d["children"] = []
+            by_id[s.span_id] = d
+        roots: List[Dict[str, Any]] = []
+        for s in spans:
+            d = by_id[s.span_id]
+            if s.parent_id and s.parent_id in by_id:
+                by_id[s.parent_id]["children"].append(d)
+            else:
+                roots.append(d)
+        return {
+            "trace_id": trace_id,
+            "span_count": len(spans),
+            "flag": self.trace_flag(trace_id),
+            "roots": roots,
+        }
 
     def trace_summary(self, trace_id: str) -> str:
         """'http 12.3ms -> parse 1.1ms -> score 8.0ms -> reply 0.9ms' —

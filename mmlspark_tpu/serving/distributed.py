@@ -44,14 +44,21 @@ import json
 import socket
 import threading
 import time
-from typing import Callable, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.obs.logging import get_logger
 from mmlspark_tpu.obs import registry as obs_registry
-from mmlspark_tpu.serving.fabric import FabricConfig, ServingFabric
+from mmlspark_tpu.obs import tracer as obs_tracer
+from mmlspark_tpu.obs.slo import slo_monitor
+from mmlspark_tpu.obs.tracing import Span, extract_context, inject_context
+from mmlspark_tpu.serving.fabric import (
+    CircuitBreaker,
+    FabricConfig,
+    ServingFabric,
+)
 from mmlspark_tpu.serving.faults import FaultInjector
-from mmlspark_tpu.serving.server import ServingServer
+from mmlspark_tpu.serving.server import ServingServer, _trace_payload
 
 log = get_logger("mmlspark_tpu.serving")
 
@@ -90,6 +97,7 @@ class DistributedServingServer:
         fabric: Optional[FabricConfig] = None,
         worker_timeout: Optional[float] = None,
         fault_injector: Optional[FaultInjector] = None,
+        slow_request_ms: Optional[float] = None,
     ):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
@@ -111,6 +119,11 @@ class DistributedServingServer:
             request_timeout=request_timeout,
             engine=engine,
             in_flight_depth=in_flight_depth,
+            # workers share the gateway's slow threshold: a gateway-routed
+            # slow request then logs BOTH sides under one propagated
+            # trace id (gateway line: worker/attempts/queue-wait; worker
+            # line: stage decomposition)
+            slow_request_ms=slow_request_ms,
         )
         self.workers: List[ServingServer] = [
             self._make_worker() for _ in range(n_workers)
@@ -122,6 +135,19 @@ class DistributedServingServer:
             gateway_label=f"{api_name}-gw",
         )
         self._faults = fault_injector
+        # gateway-edge observability: the gateway is an HTTP edge like any
+        # ServingServer, so it reports into the SAME latency family (its
+        # engine label is the fabric's gateway label) and the SLO monitor
+        # sees gateway-visible outcomes (shed 429s, forwarded 5xx) that
+        # never reach a worker's histogram; slow_request_ms logs actionable
+        # slow lines (worker, attempts, queue wait) without opening traces
+        self.slow_request_ms = slow_request_ms
+        self._tracer = obs_tracer()
+        self._lat_hist = obs_registry().histogram(
+            "serving_request_latency_ms",
+            "End-to-end request latency at the HTTP edge",
+            ("engine", "code"),
+        )
         # keep-alive connections to workers, one per (gateway thread, worker);
         # the generation counter invalidates every thread's cached connection
         # to a slot when replace_worker swaps it
@@ -186,20 +212,27 @@ class DistributedServingServer:
                 entry[1].close()
 
     def _attempt(self, idx: int, method: str, path: str, body: bytes,
-                 content_type: Optional[str]) -> _Result:
+                 content_type: Optional[str],
+                 span: Optional[Span] = None) -> _Result:
         """One forward to worker idx over the cached keep-alive connection.
 
-        A stale keep-alive (the worker closed an idle connection) rebuilds
-        and retries ONCE against the same worker — but, unlike the old
-        gateway, the staleness is reported to the router as a failure
-        signal first, so a worker that keeps dropping connections
-        accumulates breaker failures instead of being silently retried
-        forever. Timeouts are NOT retried here: a wedged worker won't
-        answer a fresh connection either — surface to the failover policy.
+        `span` is this attempt's span: its W3C traceparent is injected into
+        the forwarded headers so the worker's http span parents under it —
+        the cross-process link graftcheck's untraced-cross-process-call
+        rule pins in place. A stale keep-alive (the worker closed an idle
+        connection) rebuilds and retries ONCE against the same worker —
+        but, unlike the old gateway, the staleness is reported to the
+        router as a failure signal first (and attached as a span event), so
+        a worker that keeps dropping connections accumulates breaker
+        failures instead of being silently retried forever. Timeouts are
+        NOT retried here: a wedged worker won't answer a fresh connection
+        either — surface to the failover policy.
         """
         if self._faults is not None:
             self._faults.intercept(idx, self.worker_timeout)
-        headers = {"Content-Type": content_type or "application/json"}
+        headers = inject_context(
+            span, {"Content-Type": content_type or "application/json"}
+        )
         try:
             conn = self._worker_conn(idx)
             conn.request(method, path, body=body, headers=headers)
@@ -213,6 +246,9 @@ class DistributedServingServer:
             # (the rebuild failing too) feeds the breaker — a single stale
             # blip whose retry succeeds must not eject the worker
             self.fabric.record_failure(idx, kind="stale_conn", breaker=False)
+            if span is not None and span.recording:
+                span.add_event("stale_conn_rebuild", worker=idx,
+                               error=repr(e))
             try:
                 conn = self._worker_conn(idx)
                 conn.request(method, path, body=body, headers=headers)
@@ -231,11 +267,17 @@ class DistributedServingServer:
 
     def _route_once(self, method: str, path: str, body: bytes,
                     content_type: Optional[str],
-                    exclude: Tuple[int, ...]) -> Tuple[Optional[_Result], Optional[int]]:
+                    exclude: Tuple[int, ...],
+                    parent_span: Optional[Span] = None,
+                    attempt_no: int = 1,
+                    kind: str = "primary") -> Tuple[Optional[_Result], Optional[int]]:
         """One routed attempt: pick a worker, forward, feed the router.
-        Returns (result, worker_idx); result is None on transport failure
-        (the failure is already recorded), worker_idx is None when nothing
-        was routable."""
+        Every attempt — primary, retry, hedge, half-open probe — is a
+        distinct child span under the gateway's request span, tagged with
+        worker index, attempt number and breaker state; breaker
+        transitions it causes attach as span events. Returns (result,
+        worker_idx); result is None on transport failure (the failure is
+        already recorded), worker_idx is None when nothing was routable."""
         picked = self.fabric.pick_and_acquire(exclude)
         if picked is None and exclude:
             # every routable worker already failed this request; retrying
@@ -243,52 +285,104 @@ class DistributedServingServer:
             picked = self.fabric.pick_and_acquire(())
         if picked is None:
             return None, None
-        idx, _probe = picked
+        idx, probe = picked
+        tr = self._tracer
+        span = tr.start_span(
+            "attempt", parent=parent_span,
+            attrs={"worker": idx, "attempt": attempt_no, "kind": kind,
+                   "probe": probe,
+                   "breaker": self.fabric.breaker_state(idx)},
+        )
         t0 = time.monotonic()
         try:
-            result = self._attempt(idx, method, path, body, content_type)
+            result = self._attempt(idx, method, path, body, content_type,
+                                   span=span)
         except (http.client.HTTPException, ConnectionError, OSError) as e:
             self.fabric.release(idx)
-            self.fabric.record_failure(idx)
-            log.warning("worker_failed", worker=idx, error=repr(e))
+            state = self.fabric.record_failure(idx)
+            if span.recording:
+                span.set_attribute("error", repr(e))
+                if state != CircuitBreaker.CLOSED:
+                    span.add_event("breaker_transition", worker=idx,
+                                   to=state)
+            tr.end_span(span)
+            log.warning("worker_failed", worker=idx, error=repr(e),
+                        trace_id=span.trace_id if span.recording else None)
             return None, idx
         self.fabric.release(idx)
         latency_ms = (time.monotonic() - t0) * 1e3
+        if span.recording:
+            span.set_attribute("status_code", result[0])
         if result[0] == 503:
             # the worker itself is shedding/stopping: a failure signal for
             # the router AND grounds to fail over, same as a transport error
-            self.fabric.record_failure(idx, kind="worker_503")
+            state = self.fabric.record_failure(idx, kind="worker_503")
+            if span.recording:
+                span.set_attribute("error", "worker 503")
+                if state != CircuitBreaker.CLOSED:
+                    span.add_event("breaker_transition", worker=idx,
+                                   to=state)
+            tr.end_span(span)
             return result, idx
         self.fabric.record_success(idx, latency_ms)
+        tr.end_span(span)
         return result, idx
 
     def _route_and_forward(self, method: str, path: str, body: bytes,
-                           content_type: Optional[str]) -> _Result:
+                           content_type: Optional[str],
+                           parent_span: Optional[Span] = None,
+                           info: Optional[Dict[str, Any]] = None,
+                           first_kind: str = "primary") -> _Result:
         """Forward with failover: budgeted retries against different
         workers with full-jitter backoff. Exhausted budget/attempts surface
-        the last worker answer (a 503) or a 502."""
+        the last worker answer (a 503) or a 502. `info` accumulates the
+        routing story (attempts, workers tried, total backoff wait) for the
+        gateway's slow_request log line; retries mark the trace interesting
+        so tail retention pins the whole tree. `first_kind` tags the first
+        attempt's span ("primary", or "hedge" on the hedged branch) so the
+        assembled tree distinguishes the hedge from the request it races."""
         cfg = self.fabric.config
         exclude: List[int] = []
         last_result: Optional[_Result] = None
         attempt = 0
+        info = info if info is not None else {}
+        tr = self._tracer
         while True:
             result, idx = self._route_once(
-                method, path, body, content_type, tuple(exclude)
+                method, path, body, content_type, tuple(exclude),
+                parent_span=parent_span, attempt_no=attempt + 1,
+                kind="retry" if attempt else first_kind,
             )
             if idx is None:
                 self.fabric.shed("no_healthy_workers")
+                if parent_span is not None and parent_span.recording:
+                    parent_span.add_event("shed",
+                                          reason="no_healthy_workers")
+                    tr.mark_trace(parent_span.trace_id, "shed")
                 return (
                     503, "Service Unavailable", "application/json",
                     b'{"error": "no healthy workers"}',
                 )
+            info["attempts"] = info.get("attempts", 0) + 1
+            info.setdefault("workers", []).append(idx)
             if result is not None and result[0] != 503:
+                info["worker"] = idx
                 return result
             last_result = result or last_result
             exclude.append(idx)
             attempt += 1
             if attempt > cfg.max_retries or not self.fabric.try_retry():
                 break
-            time.sleep(self.fabric.backoff_s(attempt))
+            backoff_s = self.fabric.backoff_s(attempt)
+            if parent_span is not None and parent_span.recording:
+                parent_span.add_event(
+                    "retry", attempt=attempt, failed_worker=idx,
+                    backoff_ms=round(backoff_s * 1e3, 2),
+                )
+                tr.mark_trace(parent_span.trace_id, "retry")
+            info["backoff_ms"] = info.get("backoff_ms", 0.0) + backoff_s * 1e3
+            time.sleep(backoff_s)
+        info["worker"] = exclude[-1] if exclude else None
         if last_result is not None:
             return last_result
         return (
@@ -297,26 +391,55 @@ class DistributedServingServer:
         )
 
     def _forward_api(self, method: str, path: str, body: bytes,
-                     content_type: Optional[str]) -> _Result:
+                     content_type: Optional[str],
+                     parent_span: Optional[Span] = None,
+                     info: Optional[Dict[str, Any]] = None) -> _Result:
         """The api-route entry: plain failover, or tail-hedged failover
-        when the fabric config enables hedging."""
+        when the fabric config enables hedging. Hedge launch and win/loss
+        attach as span events on the request tree."""
+        info = info if info is not None else {}
         if self._hedge_pool is None:
-            return self._route_and_forward(method, path, body, content_type)
+            return self._route_and_forward(method, path, body, content_type,
+                                           parent_span, info)
         import concurrent.futures as cf
 
+        p_info: Dict[str, Any] = {}
         primary = self._hedge_pool.submit(
-            self._route_and_forward, method, path, body, content_type
+            self._route_and_forward, method, path, body, content_type,
+            parent_span, p_info,
         )
-        done, _ = cf.wait([primary], timeout=self.fabric.hedge_delay_s())
+        delay_s = self.fabric.hedge_delay_s()
+        done, _ = cf.wait([primary], timeout=delay_s)
         if done or not self.fabric.try_retry(kind="hedge"):
-            return primary.result()
+            result = primary.result()
+            info.update(p_info)
+            return result
+        tr = self._tracer
+        if parent_span is not None and parent_span.recording:
+            parent_span.add_event("hedge_launched",
+                                  delay_ms=round(delay_s * 1e3, 2))
+            tr.mark_trace(parent_span.trace_id, "hedge")
+        h_info: Dict[str, Any] = {}
         hedge = self._hedge_pool.submit(
-            self._route_and_forward, method, path, body, content_type
+            self._route_and_forward, method, path, body, content_type,
+            parent_span, h_info, first_kind="hedge",
         )
+        info["hedged"] = True
         for fut in cf.as_completed([primary, hedge]):
             result = fut.result()
             if result[0] < 500:
+                winner = "primary" if fut is primary else "hedge"
+                if parent_span is not None and parent_span.recording:
+                    parent_span.add_event("hedge_result", winner=winner,
+                                          status=result[0])
+                # best-effort merge: the loser may still be mutating its
+                # own info dict — never read it for anything load-bearing
+                info.update(p_info if fut is primary else h_info)
                 return result
+        if parent_span is not None and parent_span.recording:
+            parent_span.add_event("hedge_result", winner="none",
+                                  status=result[0])
+        info.update(p_info)
         return result  # both 5xx: surface the last
 
     # -- drain / hot restart ---------------------------------------------------
@@ -450,11 +573,12 @@ class DistributedServingServer:
                     )
                     return
                 if route == "/debug/trace":
-                    from mmlspark_tpu.obs import tracer as obs_tracer
-
+                    # ?trace_id= serves the assembled cross-hop tree
+                    # (gateway root -> attempts -> worker stages); no
+                    # query keeps the whole-ring Chrome-trace dump
                     self._send_body(
                         200, "OK",
-                        json.dumps(obs_tracer().chrome_trace()
+                        json.dumps(_trace_payload(self.path)
                                    ).encode("utf-8"),
                         "application/json",
                     )
@@ -470,24 +594,42 @@ class DistributedServingServer:
                         b'{"error": "gateway stopping"}', "application/json",
                     )
                     return
+                # the gateway's root span: every fabric decision this
+                # request triggers (attempts, retries, hedges, sheds,
+                # breaker trips) hangs off it, and its traceparent rides
+                # to the worker so the worker's http/parse/score/reply
+                # spans join the SAME tree. An upstream caller's own
+                # traceparent is honored — the gateway can itself be a hop.
+                gw_span = outer._tracer.start_span(
+                    "gateway", context=extract_context(self.headers),
+                    attrs={"path": self.path, "method": self.command,
+                           "gateway": outer.fabric.gateway_label},
+                )
+                t0 = time.monotonic()
                 # admission control: shed NOW rather than queue to death.
                 # admission.in_flight doubles as the gateway's in-flight
                 # meter (stop() waits on it).
                 if not outer.fabric.admission.try_acquire():
                     outer.fabric.shed("admission")
+                    if gw_span.recording:
+                        gw_span.add_event("shed", reason="admission")
+                        outer._tracer.mark_trace(gw_span.trace_id, "shed")
+                    outer._finish_gateway(gw_span, 429, t0, None)
                     self._send_body(
                         429, "Too Many Requests",
                         b'{"error": "overloaded, retry later"}',
                         "application/json",
-                        extra_headers=(("Retry-After", "1"),),
+                        extra_headers=(("Retry-After", "1"),)
+                        + outer._trace_header(gw_span),
                     )
                     return
                 outer.fabric.fund_retry_budget()
-                t0 = time.monotonic()
+                route_info: Dict[str, Any] = {}
                 try:
                     status, reason, ct, payload = outer._forward_api(
                         self.command, self.path, body,
                         self.headers.get("Content-Type"),
+                        gw_span, route_info,
                     )
                 except Exception as e:  # defensive: policy must not 500 the gateway
                     log.exception("gateway_forward_failed")
@@ -500,8 +642,10 @@ class DistributedServingServer:
                 outer.fabric.admission.release(
                     latency_ms, overloaded=status in (502, 503)
                 )
+                outer._finish_gateway(gw_span, status, t0, route_info)
                 self._send_body(status, reason, payload,
-                                ct or "application/json")
+                                ct or "application/json",
+                                extra_headers=outer._trace_header(gw_span))
 
             do_GET = do_POST
             do_PUT = do_POST
@@ -522,22 +666,84 @@ class DistributedServingServer:
         )
         return self
 
+    @staticmethod
+    def _trace_header(span: Span) -> Tuple[Tuple[str, str], ...]:
+        """An ``X-Trace-Id`` response header while the request is traced,
+        so a client holding a slow/failed response can fetch its tree from
+        ``GET /debug/trace?trace_id=`` without log archaeology."""
+        if span is not None and span.recording:
+            return (("X-Trace-Id", span.trace_id),)
+        return ()
+
+    def _finish_gateway(self, span: Span, status: int, t0: float,
+                        info: Optional[Dict[str, Any]]) -> None:
+        """Close out one gateway request: end the root span (5xx marks the
+        trace erred, so tail retention pins it), record edge latency into
+        the shared serving_request_latency_ms family under the gateway
+        label, feed the SLO monitor, and emit the actionable slow_request
+        line (worker index, attempt count, total backoff queue-wait) when
+        over `slow_request_ms`."""
+        dt_ms = (time.monotonic() - t0) * 1e3
+        traced = span is not None and span.recording
+        info = info or {}
+        if traced:
+            span.set_attribute("status_code", status)
+            if info.get("attempts"):
+                span.set_attribute("attempts", info["attempts"])
+            if info.get("worker") is not None:
+                span.set_attribute("worker", info["worker"])
+            if status >= 500:
+                span.set_attribute("error", f"http {status}")
+            self._tracer.end_span(span)
+        gw_label = self.fabric.gateway_label
+        self._lat_hist.labels(engine=gw_label, code=str(status)).observe(
+            dt_ms,
+            trace_id=span.trace_id if traced else None,
+            span_id=span.span_id if traced else None,
+        )
+        slo_monitor().observe(
+            gw_label, status, dt_ms,
+            trace_id=span.trace_id if traced else None,
+        )
+        if self.slow_request_ms is not None and dt_ms >= self.slow_request_ms:
+            log.warning(
+                "slow_request", gateway=gw_label, status=status,
+                latency_ms=round(dt_ms, 1),
+                threshold_ms=self.slow_request_ms,
+                worker=info.get("worker"),
+                attempts=info.get("attempts", 0),
+                queue_wait_ms=round(info.get("backoff_ms", 0.0), 1),
+                hedged=bool(info.get("hedged")),
+                span_path=(
+                    self._tracer.trace_summary(span.trace_id)
+                    if traced else "untraced"
+                ),
+                trace_id=span.trace_id if traced else None,
+            )
+
     def _healthz(self) -> Tuple[int, bytes]:
         """Gateway liveness: 200 while at least one worker is routable (the
         gateway can still serve — that is the whole point of the fabric),
         503 when none are or the gateway is stopping. `status` grades it:
-        ok (everything green) / degraded (serving around failures) /
-        stopping / unavailable."""
+        ok (everything green) / degraded (serving around failures OR a
+        page-severity SLO burn alert is active) / stopping / unavailable.
+        SLO burn keeps the 200 — a burning gateway is still the place to
+        send traffic; the status string is the operator signal."""
         healths = [w.health() for w in self.workers]
         router = self.fabric.snapshot()
         routable = [w for w in router["workers"] if w["healthy"]]
         stopping = self._stopping.is_set()
+        gw_label = self.fabric.gateway_label
+        slos = slo_monitor().status(engine=gw_label)
+        slo_degraded = slo_monitor().page_burn_active(engine=gw_label)
         if stopping:
             status, code = "stopping", 503
         elif not routable:
             status, code = "unavailable", 503
-        elif len(routable) < len(self.workers) or not all(
-            h[0] for h in healths
+        elif (
+            len(routable) < len(self.workers)
+            or not all(h[0] for h in healths)
+            or slo_degraded
         ):
             status, code = "degraded", 200
         else:
@@ -546,6 +752,7 @@ class DistributedServingServer:
             "status": status,
             "workers": [h[1] for h in healths],
             "router": router,
+            "slos": slos,
         }, sort_keys=True).encode("utf-8")
         return code, body
 

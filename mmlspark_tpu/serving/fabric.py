@@ -501,7 +501,7 @@ class ServingFabric:
                 w.unroutable_at = None
 
     def record_failure(self, idx: int, kind: str = "transport",
-                       breaker: bool = True) -> None:
+                       breaker: bool = True) -> str:
         """A transport-level failure (connect refused, read timeout, worker
         503): counted per kind in `serving_fabric_failures_total`, and fed
         to the breaker so repeated failures eject the worker. `breaker=
@@ -509,7 +509,9 @@ class ServingFabric:
         breaker consequences — the stale-keep-alive rebuild uses it: a
         single stale blip whose same-worker retry succeeds must not eject a
         provably-serving worker, while a rebuild that fails too comes back
-        through the hard path."""
+        through the hard path. Returns the breaker state AFTER the record,
+        so the gateway can attach a breaker-transition span event to the
+        request tree that caused it."""
         self._failures_total.labels(
             gateway=self.gateway_label, kind=kind
         ).inc()
@@ -520,6 +522,12 @@ class ServingFabric:
                 w.breaker.record_failure()
                 if not w.breaker.allows() and w.unroutable_at is None:
                     w.unroutable_at = self._clock()
+            return w.breaker.state
+
+    def breaker_state(self, idx: int) -> str:
+        """Worker `idx`'s breaker state (for span attrs on routed attempts)."""
+        with self._lock:
+            return self._workers[idx].breaker.state
 
     def unroutable_since(self, idx: int) -> Optional[float]:
         """Monotonic time at which the router first observed worker `idx`

@@ -84,6 +84,8 @@ from mmlspark_tpu.io.http.schema import (
 )
 from mmlspark_tpu.obs import registry as obs_registry
 from mmlspark_tpu.obs import tracer as obs_tracer
+from mmlspark_tpu.obs.slo import slo_monitor
+from mmlspark_tpu.obs.tracing import extract_context
 from mmlspark_tpu.utils.profiling import (
     ServingPipelineCounters,
     dataplane_counters,
@@ -644,8 +646,11 @@ class ServingServer:
                     return
                 if route == "/debug/trace":
                     self._drain_body()
+                    # ?trace_id= serves the assembled cross-hop TREE for
+                    # one trace; no query keeps the Chrome-trace dump of
+                    # the whole ring (docs/observability.md)
                     body = json.dumps(
-                        obs_tracer().chrome_trace()
+                        _trace_payload(self.path)
                     ).encode("utf-8")
                     self._send(HTTPResponseData.ok(body))
                     return
@@ -657,11 +662,16 @@ class ServingServer:
                     return
                 t_http = time.monotonic()
                 rid = str(uuid.uuid4())
+                # cross-process propagation: a gateway-routed request
+                # carries traceparent, so this http span parents under the
+                # gateway's attempt span and the whole hop chain shares
+                # one trace id (absent/malformed headers -> fresh root)
+                ctx = extract_context(self.headers)
                 if outer.mode == "continuous":
                     exchange = _Exchange(self._read_request())
                     exchange.rid = rid
                     exchange.span = outer._tracer.start_span(
-                        "http",
+                        "http", context=ctx,
                         attrs={"request_id": rid, "path": self.path,
                                "method": self.command, "mode": outer.mode},
                     )
@@ -674,7 +684,7 @@ class ServingServer:
                     )
                     exchange.rid = rid
                     exchange.span = outer._tracer.start_span(
-                        "http",
+                        "http", context=ctx,
                         attrs={"request_id": rid, "path": self.path,
                                "method": self.command, "mode": outer.mode},
                     )
@@ -943,8 +953,21 @@ class ServingServer:
         stopping = self._stopping.is_set()
         started = self._httpd is not None
         ok = started and not stopping and all(threads.values())
+        # SLO health rides along: a page-severity burn alert on a spec
+        # covering this engine degrades the REPORTED status without
+        # flipping liveness (a burning-but-alive server must not be
+        # ejected by the gateway's health routing — it is still the best
+        # place for the traffic it can serve)
+        slos = slo_monitor().status(engine=self._obs_label)
+        slo_degraded = slo_monitor().page_burn_active(
+            engine=self._obs_label
+        )
+        status = "ok" if ok else ("stopping" if stopping else "degraded")
+        if ok and slo_degraded:
+            status = "degraded"
         info: Dict[str, Any] = {
-            "status": "ok" if ok else ("stopping" if stopping else "degraded"),
+            "status": status,
+            "slos": slos,
             "mode": self.mode,
             "engine": self.engine,
             "engine_label": self._obs_label,
@@ -983,6 +1006,13 @@ class ServingServer:
             dt_ms,
             trace_id=span.trace_id if traced else None,
             span_id=span.span_id if traced else None,
+        )
+        # the SLO engine sees the same stream the latency family records:
+        # availability/latency objectives selecting this engine label
+        # evaluate over exactly these observations
+        slo_monitor().observe(
+            self._obs_label, code, dt_ms,
+            trace_id=span.trace_id if traced else None,
         )
         if self.slow_request_ms is not None and dt_ms >= self.slow_request_ms:
             path = (
@@ -1319,6 +1349,20 @@ class ServingServer:
                     "d2h_transfers": float(work.get("d2h", 0)),
                 }
             )
+
+
+def _trace_payload(path: str) -> Dict[str, Any]:
+    """The GET /debug/trace body: the assembled tree for ?trace_id=, the
+    whole ring as Chrome trace_event JSON otherwise. Shared by
+    ServingServer and the distributed gateway (same process tracer)."""
+    import urllib.parse
+
+    query = path.split("?", 1)[1] if "?" in path else ""
+    opts = urllib.parse.parse_qs(query)
+    tid = opts.get("trace_id", [""])[-1]
+    if tid:
+        return obs_tracer().trace_tree(tid)
+    return obs_tracer().chrome_trace()
 
 
 def _status(code: int, reason: str, body: bytes = b"") -> HTTPResponseData:

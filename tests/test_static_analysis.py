@@ -325,6 +325,70 @@ def test_network_rule_allows_timeouts_and_unrelated_calls():
     assert not {f.line for f in findings} & clean_lines
 
 
+# -- cross-process tracing ----------------------------------------------------
+
+
+def test_untraced_cross_process_call_fires_and_suppresses():
+    from mmlspark_tpu.analysis.cross_process import check_cross_process
+
+    path = os.path.join(FIXTURES, "trace_bad.py")
+    findings = check_cross_process([path], repo_root=FIXTURES)
+    _assert_matches_markers("trace_bad.py", findings)
+
+
+def test_cross_process_rule_allows_injected_headers():
+    """Every visible injection shape — direct inject call, assignment from
+    one, mutation by one, explicit traceparent stores, literal dicts and
+    **kwargs splats — must pass, as must non-HTTP .request lookalikes."""
+    from mmlspark_tpu.analysis.cross_process import check_cross_process
+
+    path = os.path.join(FIXTURES, "trace_bad.py")
+    findings = check_cross_process([path], repo_root=FIXTURES)
+    with open(path) as f:
+        clean_lines = {
+            i for i, line in enumerate(f, start=1) if "clean" in line
+        }
+    assert not {f.line for f in findings} & clean_lines
+
+
+def test_cross_process_rule_scoped_to_serving(tmp_path):
+    """The runner only feeds serving/ files to the rule: an untraced
+    .request elsewhere in the package (a downloader, a test client) is not
+    a gateway hop and must not fail the package scan."""
+    pkg = tmp_path / "mmlspark_tpu"
+    (pkg / "serving").mkdir(parents=True)
+    (pkg / "downloader").mkdir()
+    bad = "def f(conn, body):\n    conn.request('POST', '/x', body)\n"
+    (pkg / "serving" / "gw.py").write_text(bad)
+    (pkg / "downloader" / "dl.py").write_text(bad)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "serving" / "__init__.py").write_text("")
+    (pkg / "downloader" / "__init__.py").write_text("")
+    findings = [
+        f for f in run_all(
+            str(tmp_path), select=["untraced-cross-process-call"]
+        )
+        if f.rule == "untraced-cross-process-call"
+    ]
+    assert [f.path for f in findings] == [
+        os.path.join("mmlspark_tpu", "serving", "gw.py")
+    ]
+
+
+def test_gateway_forward_path_is_traced():
+    """The live package scan proves the tentpole wiring: every
+    cross-process send in mmlspark_tpu/serving/ carries visible
+    traceparent injection (distributed.py's forward + rebuild paths)."""
+    from mmlspark_tpu.analysis.cross_process import check_cross_process
+
+    serving = os.path.join(REPO, "mmlspark_tpu", "serving")
+    paths = [
+        os.path.join(serving, f)
+        for f in sorted(os.listdir(serving)) if f.endswith(".py")
+    ]
+    assert check_cross_process(paths, repo_root=REPO) == []
+
+
 # -- atomic artifact writes ---------------------------------------------------
 
 

@@ -1,0 +1,502 @@
+"""ISSUE 14 tests: W3C cross-process trace propagation, tail-based span
+retention, the SLO burn-rate engine, and the live gateway+workers
+integration (one trace id from admission through retries to the worker's
+stage tree, /healthz degradation on burn alerts)."""
+
+import json
+import logging
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs.metrics import registry
+from mmlspark_tpu.obs.slo import BurnWindow, SLOMonitor, SLOSpec, slo_monitor
+from mmlspark_tpu.obs.tracing import (
+    Tracer,
+    extract_context,
+    format_traceparent,
+    inject_context,
+)
+
+
+# -- propagation round-trip ---------------------------------------------------
+
+
+class TestPropagation:
+    def test_inject_extract_identity(self):
+        tr = Tracer()
+        span = tr.start_span("gateway")
+        headers = inject_context(span, {"Content-Type": "application/json"})
+        assert headers["traceparent"].startswith("00-")
+        ctx = extract_context(headers)
+        assert ctx.trace_id == span.trace_id
+        assert ctx.span_id == span.span_id
+        assert ctx.sampled is True
+
+    def test_extracted_context_parents_the_local_span(self):
+        tr = Tracer()
+        remote = tr.start_span("gateway")
+        ctx = extract_context(inject_context(remote, {}))
+        local = tr.start_span("http", context=ctx)
+        assert local.trace_id == remote.trace_id
+        assert local.parent_id == remote.span_id
+
+    @pytest.mark.parametrize("raw", [
+        None,
+        "",
+        "garbage",
+        "00-zz-yy-01",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # all-zero trace id
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",   # all-zero span id
+        "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",   # reserved version
+        "00-" + "1" * 31 + "-" + "2" * 16 + "-01",   # short trace id
+    ])
+    def test_malformed_or_absent_traceparent_tolerated(self, raw):
+        headers = {} if raw is None else {"traceparent": raw}
+        assert extract_context(headers) is None
+        # and the serving path degrades to a fresh root, not a crash
+        tr = Tracer()
+        span = tr.start_span("http", context=extract_context(headers))
+        assert span.recording and span.parent_id is None
+
+    def test_foreign_32_hex_trace_id_preserved(self):
+        tid = "a" * 32
+        ctx = extract_context(
+            {"traceparent": f"00-{tid}-{'b' * 16}-01"}
+        )
+        assert ctx.trace_id == tid  # no padding to strip: keep verbatim
+
+    def test_sampled_flag_agreement(self):
+        tr = Tracer(sample_every=10)
+        roots = [tr.start_span(f"r{i}") for i in range(3)]
+        sampled_root, unsampled_root = roots[0], roots[1]
+        assert sampled_root.sampled and not unsampled_root.sampled
+        for root in (sampled_root, unsampled_root):
+            tp = format_traceparent(root)
+            flags = tp.rsplit("-", 1)[1]
+            assert flags == ("01" if root.sampled else "00")
+            ctx = extract_context({"traceparent": tp})
+            assert ctx.sampled is root.sampled
+            # the worker-side span honors the gateway's decision
+            worker_span = tr.start_span("http", context=ctx)
+            assert worker_span.sampled is root.sampled
+
+    def test_tracestate_passthrough(self):
+        tr = Tracer()
+        span = tr.start_span("gw")
+        headers = inject_context(span, {}, tracestate="vendor=opaque")
+        assert headers["tracestate"] == "vendor=opaque"
+        ctx = extract_context(headers)
+        assert ctx.tracestate == "vendor=opaque"
+
+    def test_disabled_tracer_injects_nothing(self):
+        tr = Tracer()
+        tr.set_enabled(False)
+        headers = inject_context(tr.start_span("x"), {"a": "b"})
+        assert "traceparent" not in headers
+
+
+# -- tail-based retention -----------------------------------------------------
+
+
+class TestTailRetention:
+    def test_overflow_keeps_erred_drops_healthy(self):
+        tr = Tracer(max_spans=8, max_pinned=8)
+        with tr.span("erred") as bad:
+            bad.set_attribute("error", "boom")
+        for i in range(40):
+            with tr.span(f"healthy{i}"):
+                pass
+        names = {s.name for s in tr.spans()}
+        assert "erred" in names          # pinned survived 40 evictions
+        assert "healthy0" not in names   # healthy rotated out
+        assert len([n for n in names if n.startswith("healthy")]) == 8
+
+    def test_latency_threshold_pins(self):
+        tr = Tracer(max_spans=4, latency_threshold_ms=50.0)
+        t0 = time.monotonic()
+        slow = tr.start_span("slow")
+        slow.t_start = t0 - 1.0
+        tr.end_span(slow, t_end=t0)
+        for i in range(20):
+            with tr.span(f"fast{i}"):
+                pass
+        assert any(s.name == "slow" for s in tr.spans())
+        assert tr.trace_flag(slow.trace_id) == "slow"
+
+    def test_mark_trace_promotes_finished_spans(self):
+        tr = Tracer(max_spans=4, max_pinned=8)
+        with tr.span("victim") as v:
+            tid = v.trace_id
+        tr.mark_trace(tid, "retry")
+        for i in range(20):
+            with tr.span(f"noise{i}"):
+                pass
+        assert any(s.trace_id == tid for s in tr.spans())
+        assert tr.trace_flag(tid) == "retry"
+
+    def test_late_flag_recovers_unsampled_children(self):
+        """Tail sampling proper: children of an unsampled trace wait in
+        limbo; when the root later errs, the WHOLE tree is pinned."""
+        tr = Tracer(max_spans=64, sample_every=2)
+        r1 = tr.start_span("root1")  # sampled (1-in-2, first wins)
+        tr.end_span(r1)
+        root = tr.start_span("root2")
+        assert not root.sampled
+        child = tr.start_span("child", parent=root)
+        assert not child.sampled
+        tr.end_span(child)
+        assert all(s.name != "child" for s in tr.spans())  # limbo: hidden
+        root.set_attribute("error", "late failure")
+        tr.end_span(root)
+        names = {s.name for s in tr.spans(root.trace_id)}
+        assert names == {"root2", "child"}
+
+    def test_healthy_sampling_one_in_n(self):
+        tr = Tracer(max_spans=64, sample_every=4)
+        for i in range(8):
+            with tr.span(f"r{i}"):
+                pass
+        kept = [s.name for s in tr.spans()]
+        assert kept == ["r0", "r4"]
+
+    def test_counters_reconcile(self):
+        tr = Tracer(max_spans=4, max_pinned=2, sample_every=2)
+        tr._limbo = type(tr._limbo)(maxlen=2)  # tiny limbo for the test
+        n = 40
+        for i in range(n):
+            with tr.span(f"s{i}") as s:
+                if i % 10 == 0:
+                    s.set_attribute("error", "x")
+        summ = tr.summary()
+        retained = summ["finished"] + summ["pinned"] + summ["limbo"]
+        assert retained + summ["dropped"] + summ["sampled_out"] == n
+        assert summ["high_water"] <= 4 + 2
+
+    def test_trace_tree_assembles_nesting(self):
+        tr = Tracer()
+        with tr.span("root") as root:
+            with tr.span("mid"):
+                with tr.span("leaf"):
+                    pass
+        tree = tr.trace_tree(root.trace_id)
+        assert tree["span_count"] == 3
+        assert len(tree["roots"]) == 1
+        r = tree["roots"][0]
+        assert r["name"] == "root"
+        assert r["children"][0]["name"] == "mid"
+        assert r["children"][0]["children"][0]["name"] == "leaf"
+
+
+# -- SLO engine ---------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _windows():
+    return (
+        BurnWindow("fast", short_s=10.0, long_s=60.0,
+                   burn_threshold=2.0, severity="page"),
+    )
+
+
+class TestSLOEngine:
+    def test_burn_alert_fires_and_resolves(self):
+        clk = _Clock()
+        mon = SLOMonitor(clock=clk, eval_interval_s=1e9)  # manual evaluate
+        spec = mon.register(SLOSpec(
+            "t_avail", target=0.9, engine="e0", windows=_windows(),
+            min_events=5,
+        ))
+        fam = registry().counter(
+            "slo_burn_alerts_total", "", ("slo", "window"))
+        before = fam.labels(slo="t_avail", window="fast").value()
+        for _ in range(10):
+            mon.observe("e0", 200, 1.0)
+        mon.evaluate()
+        assert mon.status()["t_avail"]["healthy"]
+        for _ in range(10):
+            mon.observe("e0", 500, 1.0, trace_id="feedbead00000001")
+        mon.evaluate()
+        st = mon.status()["t_avail"]
+        assert not st["healthy"] and st["burning"] == ["fast"]
+        assert st["alerts"]["fast"]["exemplar_trace_ids"]
+        assert fam.labels(slo="t_avail", window="fast").value() == before + 1
+        assert mon.page_burn_active(engine="e0")
+        assert not mon.page_burn_active(engine="other")
+        # burst stops; the short window drains -> prompt reset
+        clk.t += 15.0
+        for _ in range(10):
+            mon.observe("e0", 200, 1.0)
+        mon.evaluate()
+        assert mon.status()["t_avail"]["healthy"]
+        assert not mon.page_burn_active(engine="e0")
+        # no double-count on re-fire bookkeeping
+        assert fam.labels(slo="t_avail", window="fast").value() == before + 1
+
+    def test_min_events_guard(self):
+        clk = _Clock()
+        mon = SLOMonitor(clock=clk, eval_interval_s=1e9)
+        mon.register(SLOSpec(
+            "t_cold", target=0.9, windows=_windows(), min_events=10,
+        ))
+        for _ in range(3):
+            mon.observe("e0", 500, 1.0)
+        mon.evaluate()
+        assert mon.status()["t_cold"]["healthy"]  # too few events to page
+
+    def test_latency_objective_excludes_errors(self):
+        clk = _Clock()
+        mon = SLOMonitor(clock=clk, eval_interval_s=1e9)
+        mon.register(SLOSpec(
+            "t_lat", objective="latency", target=0.9,
+            latency_threshold_ms=100.0, windows=_windows(), min_events=5,
+        ))
+        for _ in range(10):
+            mon.observe("e0", 500, 1.0)   # an error burst...
+        for _ in range(10):
+            mon.observe("e0", 200, 5.0)   # ...amid fast successes
+        mon.evaluate()
+        assert mon.status()["t_lat"]["healthy"]  # errors are not "slow"
+        for _ in range(10):
+            mon.observe("e0", 200, 500.0)
+        mon.evaluate()
+        assert not mon.status()["t_lat"]["healthy"]
+
+    def test_error_budget_gauge(self):
+        clk = _Clock()
+        mon = SLOMonitor(clock=clk, eval_interval_s=1e9)
+        mon.register(SLOSpec(
+            "t_budget", target=0.9, windows=_windows(), min_events=1,
+        ))
+        for _ in range(19):
+            mon.observe("e0", 200, 1.0)
+        mon.observe("e0", 500, 1.0)  # 5% errors on a 10% budget
+        mon.evaluate()
+        st = mon.status()["t_budget"]
+        assert st["error_budget_remaining"] == pytest.approx(0.5, abs=0.01)
+
+    def test_observe_noops_while_disabled(self):
+        mon = SLOMonitor(eval_interval_s=1e9)
+        mon.register(SLOSpec("t_off", target=0.9, windows=_windows(),
+                             min_events=1))
+        with obs.disabled():
+            for _ in range(20):
+                mon.observe("e0", 500, 1.0)
+        mon.evaluate()
+        assert mon.status()["t_off"]["healthy"]
+        assert len(mon._events) == 0
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            SLOSpec("bad", objective="latency", target=0.9)  # no threshold
+        with pytest.raises(ValueError):
+            SLOSpec("bad", target=1.5)
+        with pytest.raises(ValueError):
+            BurnWindow("w", 10.0, 5.0, 1.0)  # short > long
+        with pytest.raises(ValueError):
+            BurnWindow("w", 1.0, 5.0, 1.0, severity="sms")
+
+
+# -- serving integration ------------------------------------------------------
+
+
+def _echo_factory():
+    from mmlspark_tpu.core.dataframe import DataType
+    from mmlspark_tpu.serving import make_reply, parse_request
+
+    def handler(df):
+        parsed = parse_request(df, {"x": None})
+        vals = np.asarray([float(v) * 2.0 for v in parsed["x"]])
+        return make_reply(
+            parsed.with_column("y", vals, DataType.DOUBLE), "y"
+        )
+    return handler
+
+
+def _post(port, api, payload):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("POST", f"/{api}", json.dumps(payload).encode(),
+                 {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    r.read()
+    tid = r.getheader("X-Trace-Id")
+    conn.close()
+    return r.status, tid
+
+
+def _get_json(port, route):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", route)
+    r = conn.getresponse()
+    body = json.loads(r.read())
+    conn.close()
+    return r.status, body
+
+
+class TestGatewayTracing:
+    def test_one_root_with_attempt_children_under_retry_load(self, caplog):
+        """The tentpole's acceptance shape, live: inject transport faults,
+        assert some request's tree is gateway root -> >=2 attempts ->
+        worker http -> parse/score/reply, fetched by trace id over HTTP;
+        the gateway's slow_request line carries worker/attempts/queue-wait
+        and the worker's slow_request line carries the SAME trace id."""
+        from mmlspark_tpu.obs import tracer
+        from mmlspark_tpu.serving import (
+            DistributedServingServer, FabricConfig, FaultInjector,
+        )
+
+        cfg = FabricConfig(failure_threshold=4, open_secs=0.3,
+                           health_interval_s=0.05, backoff_base_ms=1.0,
+                           backoff_max_ms=3.0)
+        faults = FaultInjector()
+        with caplog.at_level(logging.WARNING, logger="mmlspark_tpu.serving"):
+            with DistributedServingServer(
+                _echo_factory, n_workers=2, api_name="tt",
+                mode="micro_batch", max_wait_ms=2.0, fabric=cfg,
+                worker_timeout=2.0, fault_injector=faults,
+                slow_request_ms=0.0,
+            ) as srv:
+                for _ in range(6):
+                    status, tid = _post(srv.port, "tt", {"x": 1.0})
+                    assert status == 200 and tid
+                # instant-failing drops on each worker in turn: whichever
+                # one the router favors, some request fails over
+                for target in (0, 1):
+                    faults.drop_connections(target, n=3)
+                    for _ in range(6):
+                        _post(srv.port, "tt", {"x": 2.0})
+                    faults.heal(target)
+                tr = tracer()
+                by_trace = {}
+                for s in tr.spans():
+                    by_trace.setdefault(s.trace_id, []).append(s)
+                retried = next(
+                    tid for tid, spans in by_trace.items()
+                    if [s.name for s in spans].count("attempt") >= 2
+                    and {"gateway", "http", "parse", "score", "reply"}
+                    <= {s.name for s in spans}
+                )
+                # retried traces are flagged -> pinned by tail retention
+                assert tr.trace_flag(retried) is not None
+                code, tree = _get_json(
+                    srv.port, f"/debug/trace?trace_id={retried}"
+                )
+        assert code == 200
+        assert len(tree["roots"]) == 1
+        root = tree["roots"][0]
+        assert root["name"] == "gateway"
+        attempts = [c for c in root["children"] if c["name"] == "attempt"]
+        assert len(attempts) >= 2
+        stage_names = set()
+        for a in attempts:
+            assert {"worker", "attempt", "kind", "breaker"} <= set(a["attrs"])
+            for c in a["children"]:
+                if c["name"] == "http":
+                    stage_names |= {g["name"] for g in c["children"]}
+        assert {"parse", "score", "reply"} <= stage_names
+
+        slow_lines = []
+        for rec in caplog.records:
+            try:
+                payload = json.loads(rec.getMessage())
+            except ValueError:
+                continue
+            if payload.get("event") == "slow_request":
+                slow_lines.append(payload)
+        gw_lines = [p for p in slow_lines if "gateway" in p]
+        worker_lines = [p for p in slow_lines if "request_id" in p]
+        assert gw_lines and worker_lines
+        line = gw_lines[-1]
+        assert {"worker", "attempts", "queue_wait_ms", "trace_id"} <= set(line)
+        # the worker's slow line carries the PROPAGATED id, not a fresh one
+        gw_tids = {p["trace_id"] for p in gw_lines}
+        assert gw_tids & {p.get("trace_id") for p in worker_lines}
+
+    def test_hedge_attempt_span_tagged_hedge(self):
+        """A hedged request's racing attempt must be distinguishable in
+        the assembled tree: its span carries kind="hedge", not a second
+        kind="primary" (latency attribution for hedging depends on it)."""
+        from mmlspark_tpu.obs import tracer
+        from mmlspark_tpu.serving import (
+            DistributedServingServer, FabricConfig, FaultInjector,
+        )
+
+        faults = FaultInjector()
+        cfg = FabricConfig(hedge=True, hedge_min_ms=40.0,
+                           failure_threshold=4, open_secs=0.3,
+                           health_interval_s=0.05, backoff_base_ms=1.0,
+                           backoff_max_ms=3.0)
+        with DistributedServingServer(
+            _echo_factory, n_workers=2, api_name="hg",
+            mode="micro_batch", max_wait_ms=2.0, fabric=cfg,
+            worker_timeout=2.0, fault_injector=faults,
+        ) as srv:
+            for _ in range(4):
+                assert _post(srv.port, "hg", {"x": 1.0})[0] == 200
+            faults.slow_worker(0, 0.6)
+            faults.slow_worker(1, 0.6)
+            status, tid = _post(srv.port, "hg", {"x": 3.0})
+            assert status == 200 and tid
+            # the losing attempt's span ends only when the slow worker
+            # finally answers — wait it out so both attempts are in the ring
+            time.sleep(0.9)
+            spans = [s for s in tracer().spans() if s.trace_id == tid]
+        kinds = [
+            s.attrs.get("kind") for s in spans if s.name == "attempt"
+        ]
+        assert "hedge" in kinds, kinds
+        assert kinds.count("primary") == 1, kinds
+
+    def test_worker_healthz_degrades_on_slo_burn(self):
+        from mmlspark_tpu.serving import ServingServer
+
+        with ServingServer(_echo_factory(), api_name="hz") as srv:
+            mon = slo_monitor()
+            spec = SLOSpec(
+                f"hz-{srv._obs_label}", target=0.9,
+                engine=srv._obs_label,
+                windows=(BurnWindow("fast", 5.0, 30.0, 2.0),),
+                min_events=5,
+            )
+            mon.register(spec)
+            try:
+                ok, info = srv.health()
+                assert ok and info["status"] == "ok"
+                assert spec.name in info["slos"]
+                for _ in range(20):
+                    mon.observe(srv._obs_label, 500, 1.0)
+                mon.evaluate()
+                ok, info = srv.health()
+                assert ok  # still alive: SLO burn must not eject it
+                assert info["status"] == "degraded"
+                assert not info["slos"][spec.name]["healthy"]
+                code, body = _get_json(srv.port, "/healthz")
+                assert code == 200 and body["status"] == "degraded"
+            finally:
+                mon.unregister(spec.name)
+
+    def test_untraced_client_gets_fresh_root_and_trace_header(self):
+        from mmlspark_tpu.obs import tracer
+        from mmlspark_tpu.serving import ServingServer
+
+        with ServingServer(_echo_factory(), api_name="fr") as srv:
+            status, _tid = _post(srv.port, "fr", {"x": 1.0})
+            assert status == 200
+            http_spans = [
+                s for s in tracer().spans() if s.name == "http"
+                and s.attrs.get("path", "").startswith("/fr")
+            ]
+            assert http_spans and http_spans[-1].parent_id is None
