@@ -42,6 +42,8 @@ RULES: Dict[str, str] = {
     "full-materialize-in-stream-path": "read_all()/read_table()/whole-table to_numpy inside the streaming tier materializes O(n) rows on host; iterate bounded chunks instead",
     # unstructured-log family (unstructured_log.py)
     "unstructured-log-in-library": "logging.getLogger/bare print()/legacy core.config.get_logger in library code; log through obs.logging.get_logger (structured JSON lines with trace correlation)",
+    # device-index family (device_index.py)
+    "hardcoded-device-index": "scalar index into jax.devices()/jax.local_devices() pins work to one device outside a single-device-guarded branch; place through the mesh or a shard->device ownership map",
     # Params-contract family (params_contract.py)
     "param-converter": "simple Param declared without an explicit type converter",
     "param-doc": "stage or Param missing documentation",

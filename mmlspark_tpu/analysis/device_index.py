@@ -1,0 +1,220 @@
+"""Hardcoded-device-index rule: library code must not pin work to device 0.
+
+`hardcoded-device-index` flags scalar subscripts of ``jax.devices()`` /
+``jax.local_devices()`` — ``jax.devices()[0]`` and friends — inside
+``mmlspark_tpu/``. Pinning a buffer or computation to the first device is
+exactly the habit that kept the GBDT trainer single-chip while the rest of
+the framework grew a mesh (ISSUE 15): it works on a laptop, silently
+serializes a pod, and loses the multi-host case where ``devices()[0]`` is
+not even local. Device PLACEMENT belongs to the mesh helpers
+(``parallel/mesh.data_parallel_mesh`` and friends) or an explicit
+shard->device ownership map (``io/columnar.round_robin_owners``).
+
+Flagged, per function scope (module top-level counts as a scope):
+
+- a scalar subscript directly on the call: ``jax.devices()[0]``,
+  ``jax.local_devices()[i]`` (any non-slice index, not just 0);
+- the same through a local alias: ``devs = jax.devices()`` followed by
+  ``devs[0]`` — taint is intraprocedural in document order, like the
+  monotonic-time rule.
+
+NOT flagged:
+
+- prefix slices — ``jax.devices()[:k]`` selects a device SET for mesh
+  construction, which is the sanctioned idiom;
+- subscripts inside an ``if`` whose test PINS the device count to one
+  (``jax.device_count() == 1`` / ``<= 1`` / ``< 2``, also via
+  ``jax.local_device_count()`` or ``len(jax.devices())``, constants on
+  either side): an explicitly single-device-guarded branch has already
+  decided one device is all there is. Direction matters — the body of
+  ``if jax.device_count() > 1`` is the MULTI-device branch and stays
+  flagged.
+
+Justified uses (e.g. a device-KIND probe on a homogeneous pod) take
+``# graftcheck: ignore[hardcoded-device-index]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Set
+
+from mmlspark_tpu.analysis.base import Finding
+
+_RULE = "hardcoded-device-index"
+
+_DEVICE_FNS = {"devices", "local_devices"}
+
+
+def _jax_names(tree: ast.AST) -> Set[str]:
+    """Module aliases of jax: `import jax` / `import jax as j`."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax":
+                    out.add(alias.asname or "jax")
+    return out
+
+
+def _is_device_list_call(node: ast.AST, jax_names: Set[str]) -> bool:
+    """``jax.devices(...)`` / ``jax.local_devices(...)`` under any alias."""
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in _DEVICE_FNS
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id in jax_names
+    )
+
+
+def _is_count_read(node: ast.AST, jax_names: Set[str]) -> bool:
+    """``jax.device_count()`` / ``jax.local_device_count()`` /
+    ``len(jax.devices())`` — a device-count reading."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in ("device_count", "local_device_count")
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id in jax_names
+    ):
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "len"
+        and bool(node.args)
+        and _is_device_list_call(node.args[0], jax_names)
+    )
+
+
+def _is_count_probe(test: ast.AST, jax_names: Set[str]) -> bool:
+    """Does an `if` test ESTABLISH the single-device case — a comparison
+    pinning the device count to one (``count == 1``, ``count <= 1``,
+    ``count < 2``, or the mirrored constant-first forms)? Direction
+    matters: ``if jax.device_count() > 1`` guards the MULTI-device branch,
+    which is exactly where a device-0 pin is the bug this rule exists
+    for, so it is NOT honored."""
+    for sub in ast.walk(test):
+        if not (
+            isinstance(sub, ast.Compare)
+            and len(sub.ops) == 1
+            and len(sub.comparators) == 1
+        ):
+            continue
+        left, op, right = sub.left, sub.ops[0], sub.comparators[0]
+        if (
+            _is_count_read(left, jax_names)
+            and isinstance(right, ast.Constant)
+            and isinstance(right.value, int)
+        ):
+            c = right.value
+            if (
+                (isinstance(op, ast.Eq) and c == 1)
+                or (isinstance(op, ast.LtE) and c <= 1)
+                or (isinstance(op, ast.Lt) and c <= 2)
+            ):
+                return True
+        if (
+            _is_count_read(right, jax_names)
+            and isinstance(left, ast.Constant)
+            and isinstance(left.value, int)
+        ):
+            c = left.value
+            if (
+                (isinstance(op, ast.Eq) and c == 1)
+                or (isinstance(op, ast.GtE) and c <= 1)
+                or (isinstance(op, ast.Gt) and c <= 2)
+            ):
+                return True
+    return False
+
+
+def _guarded_lines(scope: ast.AST, jax_names: Set[str]) -> Set[int]:
+    """Physical lines living inside an `if` BODY whose test probes the
+    device count (the else branch is NOT guarded: it is the multi-device
+    side)."""
+    lines: Set[int] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, ast.If) and _is_count_probe(node.test, jax_names):
+            for stmt in node.body:
+                end = getattr(stmt, "end_lineno", stmt.lineno) or stmt.lineno
+                lines.update(range(stmt.lineno, end + 1))
+    return lines
+
+
+def _walk_scope(scope: ast.AST) -> Iterable[ast.AST]:
+    """Pre-order (document-order) walk WITHOUT descending into nested
+    function/class bodies — each nested scope gets its own taint set
+    (the monotonic-time rule's traversal contract)."""
+    body = scope.body if hasattr(scope, "body") else []
+    stack = list(reversed(body))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+
+def _scan_scope(scope: ast.AST, rel: str, jax_names: Set[str],
+                findings: List[Finding]) -> None:
+    """One function (or the module top level): propagate device-list taint
+    through assignments in document order, flag scalar subscripts outside
+    device-count-guarded branches."""
+    tainted: Set[str] = set()
+    guarded = _guarded_lines(scope, jax_names)
+    flagged: Set[int] = set()
+
+    def value_is_device_list(node: ast.AST) -> bool:
+        if _is_device_list_call(node, jax_names):
+            return True
+        return isinstance(node, ast.Name) and node.id in tainted
+
+    for node in _walk_scope(scope):
+        if isinstance(node, ast.Assign) and value_is_device_list(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    tainted.add(tgt.id)
+        if not isinstance(node, ast.Subscript):
+            continue
+        if isinstance(node.slice, ast.Slice):
+            continue  # prefix slice: selecting a device SET is fine
+        if not value_is_device_list(node.value):
+            continue
+        if node.lineno in guarded or node.lineno in flagged:
+            continue
+        flagged.add(node.lineno)
+        findings.append(Finding(
+            _RULE, rel, node.lineno,
+            "scalar index into jax.devices()/jax.local_devices() pins "
+            "work to one device; place through the mesh (parallel/mesh) "
+            "or an explicit shard->device ownership map, or guard the "
+            "branch on jax.device_count()",
+        ))
+
+
+def check_device_index(
+    paths: Iterable[str], repo_root: Optional[str] = None
+) -> List[Finding]:
+    repo_root = repo_root or os.getcwd()
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        jax_names = _jax_names(tree)
+        if not jax_names:
+            continue  # module never imports jax: nothing to index
+        rel = os.path.relpath(path, repo_root)
+        _scan_scope(tree, rel, jax_names, findings)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_scope(node, rel, jax_names, findings)
+    return findings

@@ -172,6 +172,13 @@ def run_all(
             ],
             repo_root=root,
         )
+    if "hardcoded-device-index" in enabled:
+        from mmlspark_tpu.analysis.device_index import check_device_index
+
+        # the whole library tier: pinning placement to devices()[0] is a
+        # scaling bug wherever it hides (ISSUE 15 — the GBDT trainer
+        # stayed single-chip exactly this way)
+        findings += check_device_index(package_files, repo_root=root)
     if "unstructured-log-in-library" in enabled:
         from mmlspark_tpu.analysis.unstructured_log import (
             check_unstructured_log,

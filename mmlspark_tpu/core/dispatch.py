@@ -72,6 +72,13 @@ def bucketing(enabled: bool) -> Iterator[None]:
         _BUCKETING_ENABLED = prev
 
 
+def bucketing_enabled() -> bool:
+    """Current state of the bucketing rollback lever (read by callers
+    outside this module — e.g. parallel/mesh.shard_target_rows — so the
+    one toggle governs every shape-bucketed pad in the dataplane)."""
+    return _BUCKETING_ENABLED
+
+
 _DONATION_ENABLED = True
 
 

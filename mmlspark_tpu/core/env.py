@@ -56,7 +56,14 @@ def peak_flops_per_sec() -> float:
 
     if jax.default_backend() == "cpu":
         return CPU_NOMINAL_PEAK_FLOPS
-    kind = jax.devices()[0].device_kind.lower()
+    # per-chip peak is a DEVICE-KIND property, not a device-0 property:
+    # probe every local device and require agreement, so a (hypothetical)
+    # mixed-kind mesh reports 0.0 (unknown) instead of silently assuming
+    # the whole pod runs at device 0's peak
+    kinds = {d.device_kind.lower() for d in jax.local_devices()}
+    if len(kinds) != 1:
+        return 0.0
+    kind = kinds.pop()
     for key, peak in PEAK_FLOPS_BY_KIND.items():
         if key in kind:
             return peak

@@ -138,11 +138,16 @@ def _produce(
     workers: int,
     upload: bool,
     sharding: Any,
+    placement: Optional[Callable[[Any], Any]] = None,
 ) -> None:
     def stage(item):
         t0 = time.perf_counter()
         host = stage_fn(item)
-        return host, time.perf_counter() - t0
+        # per-item device ownership (sharded ingestion): the placement
+        # callback names the device/sharding this chunk's rows live on,
+        # overriding the pipeline-wide sharding
+        tgt = placement(item) if placement is not None else sharding
+        return host, time.perf_counter() - t0, tgt
 
     try:
         source = iter(source)
@@ -164,7 +169,7 @@ def _produce(
             while futures:
                 if state.stop.is_set():
                     break
-                host, decode_s = futures.popleft().result()
+                host, decode_s, tgt = futures.popleft().result()
                 try:
                     futures.append(pool.submit(stage, next(source)))
                 except StopIteration:
@@ -174,7 +179,7 @@ def _produce(
                 if upload:
                     import jax
 
-                    batch = upload_host_chunk(host, sharding)
+                    batch = upload_host_chunk(host, tgt)
                     # block: "upload done" must mean bytes ON the device,
                     # and serialized uploads are the measured fast path
                     # for the tunnel-attached chip
@@ -245,6 +250,7 @@ class _ChunkPipeline:
         workers: int = 1,
         upload: bool = True,
         sharding: Any = None,
+        placement: Optional[Callable[[Any], Any]] = None,
     ):
         self._state = _PrefetchState(max(1, int(depth)))
         self._started = False
@@ -255,7 +261,7 @@ class _ChunkPipeline:
         self._thread = threading.Thread(
             target=_produce,
             args=(self._state, source, stage_fn,
-                  max(1, int(workers)), upload, sharding),
+                  max(1, int(workers)), upload, sharding, placement),
             name="prefetch-pipeline", daemon=True,
         )
 
@@ -376,6 +382,11 @@ class DeviceChunkPrefetcher(_ChunkPipeline):
         `summary()["resident_bytes_peak"]`.
     workers: staging pool size (stage parallelism; uploads stay serial).
     upload: False yields host payloads instead (stage-only prefetch).
+    placement: work unit -> jax Device (or Sharding) — the SHARDED upload
+        mode (ISSUE 15): each staged chunk's rows are device_put leaf-wise
+        directly onto their owning device (round-robin shard->device
+        ownership in the sharded GBDT ingestion path), counted in the same
+        dataplane metrics. Overrides `sharding` per item.
 
     Use as an iterator (or context manager for early-exit cleanup):
 
@@ -393,10 +404,12 @@ class DeviceChunkPrefetcher(_ChunkPipeline):
         workers: int = 1,
         upload: bool = True,
         sharding: Any = None,
+        placement: Optional[Callable[[Any], Any]] = None,
     ):
         super().__init__(
             chunks, stage_fn if stage_fn is not None else (lambda c: c),
             depth=depth, workers=workers, upload=upload, sharding=sharding,
+            placement=placement,
         )
 
 

@@ -48,6 +48,33 @@ def add_leaf_outputs(raw, assign, leaf_values):
     return raw + leaf_values[assign]
 
 
+@functools.partial(jax.jit, static_argnames=("col",), donate_argnums=(0,))
+def add_leaf_outputs_col(raw, assign, leaf_values, *, col: int):
+    """Multiclass add_leaf_outputs: raw[:, col] += leaf_values[assign] for
+    one class column of a (n, k) raw-score shard (the data-parallel
+    engine's per-device update; `col` is static so the compiled program is
+    transfer-free on warm dispatch)."""
+    return raw.at[:, col].add(leaf_values[assign])
+
+
+@functools.partial(jax.jit, static_argnames=("col",))
+def take_class_column(arr, *, col: int):
+    """arr[:, col] as a compiled program — the data-parallel engine slices
+    per-class gradient columns out of a device-resident (m, k) shard
+    without promoting index scalars host->device per call."""
+    return arr[:, col]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def reset_assign(assign):
+    """Fresh all-zeros leaf assignment for a resident shard (every tree
+    starts with all rows in leaf 0); donation reuses the shard's buffer on
+    its own device — no host round trip, no reallocation."""
+    import jax.numpy as jnp
+
+    return jnp.zeros_like(assign)
+
+
 # Features whose bin count fits this width join the narrow one-hot group
 # (categoricals and low-cardinality numerics); the rest pay the full B.
 _SMALL_HIST_B = 64
@@ -1014,6 +1041,19 @@ def route_hist_chunk(
     import jax.numpy as jnp
 
     bins = bins.astype(jnp.int32)  # uint8 wire format -> device int32 once
+    if hist_impl == "pallas":
+        # single-device TPU: routing + small-child histogram as ONE fused
+        # Pallas pass (the _route_hist_pallas design notes) instead of the
+        # XLA gather + one-hot einsum through HBM. Chunk rows must be a
+        # hist_block multiple — the streamed trainer pads ragged chunks
+        # with masked-out rows (exact: zero-weight rows add 0.0f).
+        na, h16 = _route_hist_pallas(
+            bins.T, grad.astype(jnp.float32), hess.astype(jnp.float32),
+            smask.astype(jnp.float32), assign.astype(jnp.int32),
+            member.astype(jnp.float32)[:, None],
+            feat, slot, new_slot, small_slot, num_bins, n_bins_static,
+        )
+        return na, h16[:, :3, :].transpose(0, 2, 1)
     fcol = jnp.take(bins, feat, axis=1)
     go_left = member[fcol]
     new_assign = jnp.where(
@@ -1024,3 +1064,58 @@ def route_hist_chunk(
         n_bins_static, hist_impl,
     )
     return new_assign, hist
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_bins", "n_bins_static", "hist_impl"),
+    donate_argnums=(4,),
+)
+def route_hist_shard(
+    bins,        # (m, F) uint8/int32 — ONE device-resident row shard
+    grad,        # (m,) f32 — this shard's gradient slice (device-resident)
+    hess,        # (m,) f32
+    smask,       # (m,) bool — bagging/train mask for these rows
+    assign,      # (m,) int32 — current leaf assignment (DONATED: the shard
+                 #   keeps exactly one assignment buffer on its device)
+    member,      # (B,) bool — split membership of leaf `slot` (True = left)
+    feat, slot, new_slot, small_slot,  # traced int32 scalars
+    *,
+    num_bins: int,
+    n_bins_static=None,
+    hist_impl: str = "einsum",
+):
+    """One mesh shard's share of a split step — the data-parallel engine's
+    per-device kernel. Same routing + small-child histogram semantics as
+    route_hist_chunk, but the row data never moves: bins/grad/hess/mask/
+    assign are resident on the shard's owning device, the host uploads only
+    the (B,) member mask and four scalars per pass, and fetches the (F, B, 3)
+    histogram plus TWO int32 counts. The host then sums per-shard histograms
+    in FIXED shard order (the documented deterministic accumulation order —
+    an explicit fixed-order segment reduction rather than a psum, so sharded
+    fits are bit-reproducible at a given shard count; docs/gbdt.md
+    "Distributed training").
+
+    The extra `counts` output is [rows now in `slot`, rows now in
+    `new_slot`] over ALL shard rows (unmasked — bagging must not hide rows
+    from future routing), which is what lets the host skip shards with no
+    rows in a leaf on later splits without ever fetching per-row state.
+
+    Returns (new_assign (m,) int32, hist (F, B, 3) f32, counts (2,) int32).
+    """
+    import jax.numpy as jnp
+
+    bins = bins.astype(jnp.int32)
+    fcol = jnp.take(bins, feat, axis=1)
+    go_left = member[fcol]
+    new_assign = jnp.where(
+        (assign == slot) & ~go_left, new_slot, assign
+    ).astype(jnp.int32)
+    hist = _hist_masked(
+        bins, grad, hess, smask & (new_assign == small_slot), num_bins,
+        n_bins_static, hist_impl,
+    )
+    counts = jnp.stack(
+        [(new_assign == slot).sum(), (new_assign == new_slot).sum()]
+    ).astype(jnp.int32)
+    return new_assign, hist, counts

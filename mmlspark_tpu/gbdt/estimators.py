@@ -142,6 +142,16 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
         "Checkpoint generations retained per store (older ones are deleted)",
         TypeConverters.to_int,
     )
+    engine = Param(
+        "engine",
+        "Boosting engine: auto (mesh-sharded data_parallel for plain gbdt "
+        "fits when >1 device and the fit is large enough to amortize "
+        "per-split dispatches, else fused) | data_parallel (per-device row "
+        "shards, local histograms, fixed-shard-order reduction — "
+        "deterministic at a shard count) | fused (the single-program "
+        "engine; the rollback lever). docs/gbdt.md Distributed training",
+        TypeConverters.to_string,
+    )
     stream_chunk_rows = Param(
         "stream_chunk_rows",
         "Out-of-core fit: bin and spill the dataset in chunks of this many "
@@ -188,6 +198,7 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
             checkpoint_every=10,
             checkpoint_keep_last=3,
             stream_chunk_rows=0,
+            engine="auto",
         )
 
     def _train_config(self, categorical_indexes: List[int]) -> TrainConfig:
@@ -215,6 +226,7 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
             top_rate=self.get(self.top_rate),
             other_rate=self.get(self.other_rate),
             verbosity=self.get(self.verbosity),
+            engine=self.get(self.engine),
         )
 
     def _categorical_indexes(self, df: DataFrame) -> List[int]:

@@ -68,27 +68,44 @@ def _round_device_hist():
         "gbdt_round_device_seconds",
         "Device-synchronous wall seconds per boosting round (fused: the "
         "one boost program's wall divided by its iterations, observed "
-        "once per fit; streamed: each round observed individually)",
-        ("engine",),
+        "once per fit; streamed/data_parallel: each round observed "
+        "individually). `shards` is the row-shard count the round ran "
+        "over (1 = single device).",
+        ("engine", "shards"),
     )
 
 
-def _record_boost_device_work(engine: str, seconds: float, iterations: int,
-                              rows: int, features: int, num_bins: int,
-                              num_leaves: int, num_class: int) -> None:
+def _record_boost_device_work(engine: str, shards: int, seconds: float,
+                              iterations: int, rows: int, features: int,
+                              num_bins: int, num_leaves: int,
+                              num_class: int) -> None:
     """Per-round device seconds + histogram-pass MFU for a boost run —
-    no-ops (like every profiler hook) under obs.disabled()."""
+    no-ops (like every profiler hook) under obs.disabled().
+
+    With `shards` > 1 a second `device_mfu{model="gbdt_per_device"}`
+    series records the PER-DEVICE histogram MFU (flops / shards over the
+    same round wall): rows partition uniformly over the mesh, so each
+    device executed 1/shards of the analytic hist flops — on a real pod
+    the per-device gauge is the one to compare against the chip's peak,
+    while the aggregate gauge shows the pod-level utilization."""
     from mmlspark_tpu.obs.profiler import device_profiler
 
     prof = device_profiler()
     if not prof.enabled or seconds <= 0 or iterations <= 0:
         return
-    _round_device_hist().labels(engine=engine).observe(seconds / iterations)
+    _round_device_hist().labels(
+        engine=engine, shards=str(shards)
+    ).observe(seconds / iterations)
+    flops = _hist_pass_flops(rows, features, num_bins, num_leaves,
+                             num_class) * iterations
     prof.record_device_work(
-        site=f"gbdt:{engine}", model="gbdt", seconds=seconds,
-        flops=_hist_pass_flops(rows, features, num_bins, num_leaves,
-                               num_class) * iterations,
+        site=f"gbdt:{engine}", model="gbdt", seconds=seconds, flops=flops,
     )
+    if shards > 1:
+        prof.record_device_work(
+            site=f"gbdt:{engine}:per_device", model="gbdt_per_device",
+            seconds=seconds, flops=flops / shards,
+        )
 
 
 class _ValidTracker:
@@ -173,6 +190,88 @@ class TrainConfig:
     top_rate: float = 0.2
     other_rate: float = 0.1
     verbosity: int = 1
+    # engine selection: auto | data_parallel | fused (docs/gbdt.md
+    # "Distributed training"; the scalar rollback lever for the
+    # mesh-sharded trainer)
+    engine: str = "auto"
+
+
+# Auto engine selection routes in-memory fits to the mesh-sharded
+# data-parallel engine only above this row count: below it the host-driven
+# per-split dispatches cost more than the whole fused one-program fit, so
+# small fits stay on the fused engine (explicit engine="data_parallel"
+# overrides — the parity suite and tiny-mesh experiments do exactly that).
+_DP_AUTO_MIN_ROWS = 32768
+
+
+def _guard_data_parallel(cfg: TrainConfig, valid_mask, init_raw) -> None:
+    """The data-parallel engine supports plain gbdt boosting; modes whose
+    global cross-row state does not shard cleanly are guarded explicitly
+    (the PR 8/PR 9 guard pattern) — auto selection falls back to the fused
+    engine for them instead of raising."""
+    if cfg.boosting_type != "gbdt":
+        raise ValueError(
+            f"engine='data_parallel' supports boosting_type='gbdt', not "
+            f"{cfg.boosting_type!r}: rf averages independent bagged fits, "
+            "dart rescores dropped trees over all rows, and goss ranks "
+            "global gradients — use engine='fused' (its mesh sharding "
+            "handles them) or boosting_type='gbdt'"
+        )
+    if cfg.early_stopping_round > 0 or valid_mask is not None:
+        raise ValueError(
+            "engine='data_parallel' does not support a validation split / "
+            "early stopping (per-iteration valid eval would force a "
+            "cross-shard gather every round); use engine='fused'"
+        )
+    if init_raw is not None:
+        raise ValueError(
+            "engine='data_parallel' does not support init_score_col "
+            "(per-row base margins); use engine='fused' or fold margins "
+            "into the label"
+        )
+
+
+def _resolve_engine(cfg: TrainConfig, n_rows: int, valid_mask, init_raw,
+                    streaming: bool) -> str:
+    """Pin the boosting engine for this fit (and, via cfg, for every
+    checkpoint segment of it — segments must never mix engines, so the
+    decision is made ONCE at the outermost train_booster entry from the
+    caller-visible inputs).
+
+    - "fused": the single-program engine (GSPMD-sharded over the mesh when
+      >1 device — the pre-PR15 behavior, and the rollback lever).
+    - "data_parallel": host-driven loop over per-device row shards with an
+      explicit fixed-shard-order histogram reduction. Auto-selected for
+      plain gbdt fits when >1 device and the fit is large enough to
+      amortize per-split dispatches (streamed fits shard their chunk
+      stream at any size — chunks already dispatch per split).
+    """
+    if cfg.engine == "fused":
+        return "fused"
+    if cfg.engine == "data_parallel":
+        _guard_data_parallel(cfg, valid_mask, init_raw)
+        return "data_parallel"
+    if cfg.engine != "auto":
+        raise ValueError(
+            f"unknown GBDT engine {cfg.engine!r}: expected "
+            "auto | data_parallel | fused"
+        )
+    import jax
+
+    if _FORCE_SINGLE_DEVICE or jax.device_count() <= 1:
+        return "fused"
+    supported = (
+        cfg.boosting_type == "gbdt"
+        and cfg.early_stopping_round <= 0
+        and valid_mask is None
+        and init_raw is None
+        and cfg.num_iterations > 0
+    )
+    if not supported:
+        return "fused"
+    if streaming or n_rows >= _DP_AUTO_MIN_ROWS:
+        return "data_parallel"
+    return "fused"
 
 
 def train_booster(
@@ -197,6 +296,17 @@ def train_booster(
     import jax.numpy as jnp
 
     from mmlspark_tpu.gbdt.compute import add_leaf_outputs
+
+    # Pin the engine ONCE from the caller-visible inputs and carry it in
+    # cfg: checkpoint segments and resume runs then re-derive the same
+    # decision (mixed-engine segments would break bit-parity).
+    streaming = bool(stream_chunk_rows) or _stream_data is not None
+    engine_was_auto = cfg.engine == "auto"
+    resolved = _resolve_engine(
+        cfg, int(np.asarray(y).shape[0]), valid_mask, init_raw, streaming
+    )
+    if cfg.engine != resolved:
+        cfg = dataclasses.replace(cfg, engine=resolved)
 
     if stream_chunk_rows or _stream_data is not None:
         # Out-of-core fit: the feature matrix is binned and spilled in
@@ -246,6 +356,18 @@ def train_booster(
             init_raw=init_raw, checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
             checkpoint_keep_last=checkpoint_keep_last,
+            _engine_auto=engine_was_auto,
+        )
+
+    if cfg.engine == "data_parallel":
+        # Mesh-sharded in-memory engine: per-device row shards, local
+        # histograms, explicit fixed-shard-order reduction (docs/gbdt.md
+        # "Distributed training"). Guarded modes resolved above.
+        return _train_booster_data_parallel(
+            x, y, objective, cfg,
+            sample_weight=sample_weight, init_model=init_model,
+            feature_names=feature_names, _resume_state=_resume_state,
+            _capture_resume_state=_capture_resume_state,
         )
 
     log = get_logger("mmlspark_tpu.gbdt")
@@ -610,7 +732,7 @@ def train_booster(
             if obs_registry().enabled:
                 jax.block_until_ready(result)
                 _record_boost_device_work(
-                    "fused", time.perf_counter() - t_boost,
+                    "fused", nd, time.perf_counter() - t_boost,
                     cfg.num_iterations, n_orig, f, num_bins_static,
                     cfg.num_leaves, k,
                 )
@@ -858,6 +980,23 @@ def _guard_streaming(cfg: TrainConfig, valid_mask, init_raw) -> None:
         )
 
 
+def _stream_hist_impl(engine: str) -> str:
+    """Which histogram kernel streamed chunk passes use: the fused Pallas
+    route+hist on a single real TPU chip (the ROADMAP 'streaming pins
+    einsum' fix), einsum everywhere else — CPU, and sharded streams,
+    where each owner device runs the one-hot contraction locally. Shared
+    by the streamed engine and the checkpoint fingerprint: the two paths
+    differ in f32 ulps, so a store grown on one must not silently resume
+    onto the other."""
+    import jax
+
+    if engine == "data_parallel" and jax.device_count() > 1:
+        return "einsum"
+    if jax.device_count() == 1 and jax.default_backend() == "tpu":
+        return "pallas"
+    return "einsum"
+
+
 _STREAM_METRICS: Dict[str, Any] = {}
 
 
@@ -870,6 +1009,10 @@ def _stream_metrics() -> Dict[str, Any]:
         _STREAM_METRICS["visits"] = reg.counter(
             "gbdt_stream_chunk_visits_total",
             "Chunk device passes made by streamed GBDT histogram/routing")
+        _STREAM_METRICS["dp_passes"] = reg.counter(
+            "gbdt_dp_shard_passes_total",
+            "Per-shard device histogram/routing passes made by the "
+            "data-parallel GBDT engine")
     return _STREAM_METRICS
 
 
@@ -892,6 +1035,10 @@ class _StreamData:
     chunk_rows: int
     warm_raw: Optional[np.ndarray] = None  # streamed init_model raw scores
     bins_sample_sha: Optional[str] = None  # data identity for fingerprints
+    # per-spill-chunk source READER shard ordinal (ColumnChunk.shard_index)
+    # — the sharded-streaming ownership unit for reader fits; None for
+    # array-sourced spills (no shard structure)
+    chunk_shards: Optional[List[int]] = None
 
     def cleanup(self) -> None:
         if self.spill_root:
@@ -1039,8 +1186,10 @@ def _prepare_stream_from_reader(
         )
     y = np.empty(n, np.float64)
     w = np.empty(n, np.float64) if weight_col else None
+    shard_ids: List[int] = []
 
     def chunks():
+        del shard_ids[:]  # fresh pass (binner fit, then bin/spill)
         pos = 0
         for ch in reader.iter_chunks():
             y[pos: pos + ch.rows] = np.asarray(
@@ -1050,12 +1199,18 @@ def _prepare_stream_from_reader(
                 w[pos: pos + ch.rows] = np.asarray(
                     ch.columns[weight_col], np.float64
                 )
+            shard_ids.append(int(getattr(ch, "shard_index", 0)))
             yield ch.matrix(feature_cols, np.float32)
             pos += ch.rows
 
-    return _prepare_stream(
+    data = _prepare_stream(
         chunks, n, y, w, cfg, reader.chunk_rows, init_model, spill_dir
     )
+    # reader-shard provenance per spill chunk (the last pass's order is
+    # the spill order): sharded streaming assigns device ownership by
+    # SOURCE SHARD, so on a pod each host's reader feeds its own devices
+    data.chunk_shards = list(shard_ids)
+    return data
 
 
 def train_booster_from_reader(
@@ -1080,6 +1235,14 @@ def train_booster_from_reader(
     the last good generation and regrows identical trees at the same
     chunk size."""
     _guard_streaming(cfg, None, None)
+    # pin the engine here too: this entry bypasses train_booster, and the
+    # sharded streaming decision (chunk->device ownership) must be stable
+    # across every checkpoint segment of the fit
+    resolved = _resolve_engine(
+        cfg, int(reader.num_rows or 0), None, None, streaming=True
+    )
+    if cfg.engine != resolved:
+        cfg = dataclasses.replace(cfg, engine=resolved)
     data = _prepare_stream_from_reader(
         reader, list(feature_cols), label_col, weight_col, cfg,
         init_model=init_model, spill_dir=spill_dir,
@@ -1144,6 +1307,38 @@ def _train_booster_streamed(
     categorical = [binner.is_categorical(j) for j in range(f)]
     n_bins_static = tuple(int(b) for b in binner.n_bins)
     cat_static = tuple(bool(c) for c in categorical)
+
+    # Sharded streaming (engine=data_parallel, pinned by train_booster):
+    # spilled chunks get a FIXED round-robin chunk->device ownership, the
+    # prefetcher places each chunk's rows directly onto the owning device
+    # (leaf-wise device_put, counted), and per-chunk route+hist kernels run
+    # where their chunk lives — per-host readers feeding per-chip
+    # histogram work on a real pod. Accumulation stays in global CHUNK
+    # order (not device order), so a sharded streamed fit is bit-identical
+    # to the single-device streamed fit at the same chunk size.
+    owners = None
+    n_shards = 1
+    if cfg.engine == "data_parallel" and jax.device_count() > 1:
+        from mmlspark_tpu.parallel.mesh import data_parallel_mesh
+
+        devices = list(data_parallel_mesh().devices.flat)
+        # ownership unit: the source reader shard when the spill carries
+        # that provenance (reader fits — on a pod, one host reads a shard,
+        # so all its chunks belong to that host's device), else the spill
+        # chunk ordinal (array fits — no shard structure, spread evenly)
+        units = (
+            data.chunk_shards if data.chunk_shards is not None
+            else list(range(len(data.offsets)))
+        )
+        owners = [devices[u % len(devices)] for u in units]
+        n_shards = len({u % len(devices) for u in units})
+    # Streamed chunks ride the Pallas route+hist kernel on a single real
+    # TPU chip (chunks padded to the kernel block in the stage step); the
+    # einsum path stays for CPU and for sharded streams, whose replicated
+    # one-hot contraction is what each owner device runs locally. The
+    # pick is shared with the checkpoint fingerprint (_stream_hist_impl):
+    # pallas-grown stores must not silently resume onto einsum segments.
+    hist_impl = _stream_hist_impl(cfg.engine)
     n_bins_arr = np.asarray(binner.n_bins, np.int32)
     cat_arr = np.asarray(categorical, bool)
     scalars = dict(
@@ -1265,6 +1460,7 @@ def _train_booster_streamed(
                     int(grow_cfg.max_cat_threshold),
                     n_bins_static, cat_static,
                     np.float32(cfg.learning_rate), grow_cfg, binner,
+                    hist_impl=hist_impl, owners=owners,
                 )
                 trees.append(tree)
                 if k > 1:
@@ -1275,8 +1471,8 @@ def _train_booster_streamed(
             # is device-synchronous (every chunk pass lands in np.asarray),
             # so the round wall IS queue+device time; no-op when disabled
             _record_boost_device_work(
-                "streamed", time.perf_counter() - t_round, 1, n, f,
-                num_bins, cfg.num_leaves, k,
+                "streamed", n_shards, time.perf_counter() - t_round, 1,
+                n, f, num_bins, cfg.num_leaves, k,
             )
             if cfg.verbosity > 0 and (it % 10 == 0):
                 log.info("gbdt_streamed_progress", iteration=it,
@@ -1336,25 +1532,32 @@ def _stream_grow_tree(
     learning_rate: np.float32,
     grow_cfg: GrowConfig,
     binner: BinMapper,
+    hist_impl: str = "einsum",
+    owners: Optional[List[Any]] = None,
 ):
     """Grow ONE leaf-wise tree with streamed histogram passes.
 
-    Host bookkeeping mirrors _grow_tree_body's device state slot for slot
-    (same packed finalize layout, decoded by the same unpack_tree); every
-    histogram comes from a bounded chunk pass through route_hist_chunk with
-    contributions summed in fixed chunk order. Chunks with no rows in the
-    split leaf are skipped — adding their all-zero histograms would change
-    nothing, so the skip is numerics-exact, and late splits touch only the
-    few chunks whose rows actually reach them.
+    Host bookkeeping (shared with the data-parallel engine via
+    _grow_tree_hostdriven) mirrors _grow_tree_body's device state slot for
+    slot; every histogram comes from a bounded chunk pass through
+    route_hist_chunk with contributions summed in fixed chunk order.
+    Chunks with no rows in the split leaf are skipped — adding their
+    all-zero histograms would change nothing, so the skip is
+    numerics-exact, and late splits touch only the few chunks whose rows
+    actually reach them.
+
+    `owners` (sharded streaming) maps chunk id -> owning device: the
+    prefetcher uploads each chunk's rows straight onto its owner and the
+    route+hist kernel runs there, while accumulation stays in global chunk
+    order — so sharded streamed fits are bit-identical to single-device
+    streamed fits. `hist_impl="pallas"` (single-device TPU) pads each
+    staged chunk to the Pallas block with masked-out rows (exact: zero-
+    weight rows contribute 0.0f) and runs the fused route+hist kernel.
     """
     from mmlspark_tpu.core.prefetch import DeviceChunkPrefetcher
-    from mmlspark_tpu.gbdt.compute import (
-        best_splits_for_hists,
-        route_hist_chunk,
-    )
+    from mmlspark_tpu.gbdt.compute import _HIST_BLK_SMALL, route_hist_chunk
 
-    L, B, F = num_leaves, num_bins, data.f
-    NEG = np.float32(-np.inf)
+    B, F = num_bins, data.f
     offsets, spill = data.offsets, data.spill_paths
     n_chunks = len(offsets)
     assign[:] = 0
@@ -1362,6 +1565,26 @@ def _stream_grow_tree(
     for ci, (lo, hi) in enumerate(offsets):
         counts[ci, 0] = hi - lo
     visits = _stream_metrics()["visits"]
+    pad_blk = _HIST_BLK_SMALL if hist_impl == "pallas" else 0
+
+    def stage(ci):
+        lo, hi = offsets[ci]
+        payload = {
+            "bins": np.load(spill[ci]),
+            "g": g[lo:hi], "h": h[lo:hi],
+            "mask": bag_mask[lo:hi], "assign": assign[lo:hi],
+        }
+        if pad_blk:
+            rows = hi - lo
+            pad = (-rows) % pad_blk
+            if pad:
+                payload = {
+                    k: np.concatenate(
+                        [v, np.zeros((pad,) + v.shape[1:], v.dtype)]
+                    )
+                    for k, v in payload.items()
+                }
+        return payload
 
     def chunk_pass(ids, member, feat, slot, new_slot, small_slot,
                    route: bool):
@@ -1370,16 +1593,11 @@ def _stream_grow_tree(
         the updated leaf assignment and per-chunk leaf counts back."""
         acc = np.zeros((F, B, 3), np.float32)
         ids = list(ids)
+        placement = (lambda ci: owners[ci]) if owners is not None else None
 
-        def stage(ci):
-            lo, hi = offsets[ci]
-            return {
-                "bins": np.load(spill[ci]),
-                "g": g[lo:hi], "h": h[lo:hi],
-                "mask": bag_mask[lo:hi], "assign": assign[lo:hi],
-            }
-
-        with DeviceChunkPrefetcher(iter(ids), stage, depth=2) as pf:
+        with DeviceChunkPrefetcher(
+            iter(ids), stage, depth=2, placement=placement
+        ) as pf:
             for pos, dev in enumerate(pf):
                 ci = ids[pos]
                 na, hist_c = route_hist_chunk(
@@ -1388,17 +1606,67 @@ def _stream_grow_tree(
                     np.int32(feat), np.int32(slot), np.int32(new_slot),
                     np.int32(small_slot),
                     num_bins=B, n_bins_static=n_bins_static,
-                    hist_impl="einsum",
+                    hist_impl=hist_impl,
                 )
                 if route:
                     lo, hi = offsets[ci]
-                    na_h = np.asarray(na)
+                    na_h = np.asarray(na)[: hi - lo]  # drop pallas pad rows
                     assign[lo:hi] = na_h
                     counts[ci, slot] = int((na_h == slot).sum())
                     counts[ci, new_slot] = int((na_h == new_slot).sum())
                 acc += np.asarray(hist_c)
                 visits.inc()
         return acc
+
+    return _grow_tree_hostdriven(
+        chunk_pass, counts, n_chunks, F,
+        n_bins_arr, cat_arr, fmask, scalars,
+        num_bins, num_leaves, depth_limit, max_cat_threshold,
+        n_bins_static, cat_static, learning_rate, grow_cfg, binner,
+    )
+
+
+def _grow_tree_hostdriven(
+    hist_pass,
+    counts: np.ndarray,
+    n_units: int,
+    F: int,
+    n_bins_arr: np.ndarray,
+    cat_arr: np.ndarray,
+    fmask: np.ndarray,
+    scalars: Dict[str, np.float32],
+    num_bins: int,
+    num_leaves: int,
+    depth_limit: int,
+    max_cat_threshold: int,
+    n_bins_static,
+    cat_static,
+    learning_rate: np.float32,
+    grow_cfg: GrowConfig,
+    binner: BinMapper,
+):
+    """The host-driven leaf-wise grower shared by the streamed (PR 9) and
+    data-parallel (PR 15) engines: identical split bookkeeping over
+    histograms delivered by `hist_pass`, which hides WHERE the rows live —
+    spilled chunks streamed through a prefetcher, or resident per-device
+    mesh shards.
+
+    `hist_pass(ids, member, feat, slot, new_slot, small_slot, route)`
+    routes the listed units' rows through the split of leaf `slot` and
+    returns their summed (F, B, 3) small-child histogram in FIXED unit
+    order (the deterministic accumulation contract); with `route` it also
+    maintains `counts[unit, slot]` = TRUE rows of each unit in each leaf,
+    which is what lets later splits skip units with no rows in the leaf
+    (numerics-exact: skipped units would contribute all-zero histograms).
+    Split decisions run the SAME device split rule as the fused grower
+    (compute.best_splits_for_hists), and the finalize emits the fused
+    grower's exact packed layout, decoded by the same unpack_tree.
+    """
+    from mmlspark_tpu.gbdt.compute import best_splits_for_hists
+
+    L, B = num_leaves, num_bins
+    NEG = np.float32(-np.inf)
+    n_chunks = n_units
 
     def find_splits(hists, depth_ok):
         out = best_splits_for_hists(
@@ -1412,8 +1680,8 @@ def _stream_grow_tree(
         return [np.asarray(a) for a in out]
 
     # -- root ---------------------------------------------------------------
-    hist0 = chunk_pass(range(n_chunks), np.ones(B, bool), 0, 0, 0, 0,
-                       route=False)
+    hist0 = hist_pass(range(n_chunks), np.ones(B, bool), 0, 0, 0, 0,
+                      route=False)
     hists = np.zeros((L, F, B, 3), np.float32)
     hists[0] = hist0
     stats = np.zeros((L, 3), np.float32)
@@ -1469,7 +1737,7 @@ def _stream_grow_tree(
         small_is_left = best_left[s, 2] <= best_right[s, 2]
         small_slot = s if small_is_left else new_slot
         ids = [ci for ci in range(n_chunks) if counts[ci, s] > 0]
-        small_hist = chunk_pass(
+        small_hist = hist_pass(
             ids, best_member[s], int(best_feat[s]), s, new_slot,
             int(small_slot), route=True,
         )
@@ -1530,6 +1798,350 @@ def _stream_grow_tree(
     return tree, leaf_values
 
 
+def _train_booster_data_parallel(
+    x: np.ndarray,
+    y: np.ndarray,
+    objective: Objective,
+    cfg: TrainConfig,
+    sample_weight: Optional[np.ndarray],
+    init_model: Optional[Booster],
+    feature_names: Optional[List[str]],
+    _resume_state: Optional[Dict[str, Any]],
+    _capture_resume_state: bool,
+) -> Booster:
+    """Mesh-sharded data-parallel boosting (the reference's distributed
+    LightGBM mode mapped onto the JAX mesh): rows partition contiguously
+    into one shard per device, every shard's binned rows / gradients /
+    mask / leaf assignment are DEVICE-RESIDENT for the whole fit (uploaded
+    once, updated in place via donated buffers), and each split step
+    dispatches route_hist_shard on every device that still owns rows of
+    the split leaf — local histogram build, then an explicit
+    **fixed-shard-order segment reduction** on host produces the global
+    (F, B, 3) histogram that feeds the unchanged best_splits_for_hists
+    split rule.
+
+    Determinism contract (docs/gbdt.md "Distributed training"): the
+    reduction order is the shard index order, always — not arrival order,
+    not a psum ring — so reruns at the same shard count are bit-identical,
+    and at smoke scale the whole fit is bit-identical to the single-device
+    fused fit (gated by BENCH_pr15). Bagging/feature-fraction draws
+    replicate the fused engine's host rng sequence (1024-quantized draw
+    length, pad rows masked out), so sharded == unsharded holds for
+    sampled fits too.
+
+    Per-pass traffic is O(B + 1) up and O(F*B*3 + 2) down per shard —
+    member mask and scalars in, histogram and two leaf counts out; no
+    per-row host round trip anywhere in the boosting loop. On a real pod
+    the per-shard dispatches are queued async and run concurrently; on a
+    single host they serialize, but shards whose rows never reach the
+    split leaf are skipped outright (counts bookkeeping), which is where
+    the measured hist-pass throughput win over the fused whole-row loop
+    comes from even before real parallelism.
+    """
+    import jax
+
+    from mmlspark_tpu.core.prefetch import upload_host_chunk
+    from mmlspark_tpu.gbdt.compute import (
+        add_leaf_outputs,
+        add_leaf_outputs_col,
+        reset_assign,
+        route_hist_shard,
+        take_class_column,
+    )
+    from mmlspark_tpu.parallel.mesh import data_parallel_mesh
+
+    log = get_logger("mmlspark_tpu.gbdt")
+    x = np.asarray(x, np.float64)
+    n_orig, f = x.shape
+    k = objective.num_model_per_iter
+    if hasattr(objective, "prepare"):
+        objective.prepare(y, sample_weight)
+
+    tr = obs_tracer()
+    phase_hist = obs_registry().histogram(
+        "gbdt_phase_seconds", "Wall seconds per GBDT training phase",
+        ("phase",),
+    )
+    t_bin = time.perf_counter()
+    with tr.span("gbdt:binning", rows=n_orig, features=f):
+        binner = BinMapper(cfg.max_bin, cfg.categorical_indexes)
+        binner.fit(x)
+        bins = binner.transform(x)
+    phase_hist.labels(phase="binning").observe(time.perf_counter() - t_bin)
+    num_bins = binner.max_n_bins
+    categorical = [binner.is_categorical(j) for j in range(f)]
+    n_bins_arr = np.asarray(binner.n_bins, np.int32)
+    cat_arr = np.asarray(categorical, bool)
+    n_bins_static = tuple(int(b) for b in binner.n_bins)
+    cat_static = tuple(bool(c) for c in categorical)
+
+    # Shard layout: contiguous equal slices, one per mesh device, in mesh
+    # device order — shard i's rows are [i*m, (i+1)*m) and its histograms
+    # always reduce at position i. Rows pad up to an nd multiple with
+    # zero-weight masked-out rows (exact: they contribute 0.0f to every
+    # histogram cell), so every shard compiles ONE program shape.
+    mesh = data_parallel_mesh()
+    devices = list(mesh.devices.flat)
+    nd = len(devices)
+    pad = (-n_orig) % nd
+    n = n_orig + pad
+    m = n // nd
+    bounds = [(i * m, (i + 1) * m) for i in range(nd)]
+    train_rows = np.zeros(n, bool)
+    train_rows[:n_orig] = True
+
+    wire = np.uint8 if num_bins <= 256 else np.int32
+    bins_p = np.zeros((n, f), wire)
+    bins_p[:n_orig] = bins
+    y32 = np.zeros(n, np.float32)
+    y32[:n_orig] = np.asarray(y, np.float32)
+    w32 = None
+    if sample_weight is not None:
+        w32 = np.zeros(n, np.float32)
+        w32[:n_orig] = np.asarray(sample_weight, np.float32)
+
+    # -- raw-score init (mirrors the streamed engine, then shards) ----------
+    if _resume_state is not None and _resume_state.get("raw") is not None:
+        raw0 = np.asarray(_resume_state["raw"], np.float32)
+        init_score = (
+            init_model.init_score if init_model is not None
+            else np.zeros(k, np.float64)
+        )
+    elif init_model is not None:
+        raw0 = np.asarray(init_model.predict_raw(x), np.float32)
+        init_score = init_model.init_score
+    else:
+        init_score = objective.init_score(
+            y, None if sample_weight is None else sample_weight
+        )
+        raw0 = np.zeros((n_orig, k) if k > 1 else (n_orig,), np.float32) + (
+            init_score[None, :] if k > 1 else np.float32(init_score[0])
+        )
+    if k > 1 and raw0.ndim == 1:
+        raw0 = np.repeat(raw0[:, None], k, axis=1)
+    if pad:
+        raw0 = np.concatenate(
+            [raw0, np.zeros((pad,) + raw0.shape[1:], np.float32)]
+        )
+
+    # -- per-device resident state (counted uploads, once per fit) ----------
+    t_up = time.perf_counter()
+    with tr.span("gbdt:shard_upload", rows=n, shards=nd):
+        bins_d = [
+            upload_host_chunk(bins_p[lo:hi], devices[i])
+            for i, (lo, hi) in enumerate(bounds)
+        ]
+        y_d = [
+            upload_host_chunk(y32[lo:hi], devices[i])
+            for i, (lo, hi) in enumerate(bounds)
+        ]
+        w_d = (
+            None if w32 is None else [
+                upload_host_chunk(w32[lo:hi], devices[i])
+                for i, (lo, hi) in enumerate(bounds)
+            ]
+        )
+        raw_d = [
+            upload_host_chunk(raw0[lo:hi], devices[i])
+            for i, (lo, hi) in enumerate(bounds)
+        ]
+        assign_d = [
+            upload_host_chunk(np.zeros(m, np.int32), devices[i])
+            for i in range(nd)
+        ]
+    phase_hist.labels(phase="shard_upload").observe(
+        time.perf_counter() - t_up
+    )
+    del bins_p, raw0
+
+    if w32 is None:
+        grad_fn = jax.jit(lambda r, yy: objective.grad_hess(r, yy, None))
+    else:
+        grad_fn = jax.jit(objective.grad_hess)
+
+    rng = np.random.default_rng(cfg.bagging_seed)
+    frng = np.random.default_rng(cfg.bagging_seed + 17)
+    if _resume_state is not None:
+        if _resume_state.get("rng_state") is not None:
+            rng.bit_generator.state = _resume_state["rng_state"]
+        if _resume_state.get("frng_state") is not None:
+            frng.bit_generator.state = _resume_state["frng_state"]
+
+    # the fused/streamed bag_draw: consume the 1024-quantized n_base so
+    # draw sequences — and hence trees — match across engines and shard
+    # counts; pad rows never bag in (masked by train_rows)
+    n_base = n_orig + ((-n_orig) % 1024)
+
+    def bag_draw() -> np.ndarray:
+        r = rng.random(n_base)[:n_orig]
+        return np.concatenate([r, np.ones(pad)]) if pad else r
+
+    use_bagging = cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
+    bag_mask = train_rows.copy()
+    if _resume_state is not None and _resume_state.get("bag_mask") is not None:
+        bm = np.asarray(_resume_state["bag_mask"], bool)
+        if pad:
+            bm = np.concatenate([bm, np.zeros(pad, bool)])
+        bag_mask = bm & train_rows
+    mask_d = [
+        upload_host_chunk(bag_mask[lo:hi], devices[i])
+        for i, (lo, hi) in enumerate(bounds)
+    ]
+
+    trees: List[Any] = list(init_model.trees) if init_model is not None else []
+    start_iter = len(trees) // k
+    counts = np.zeros((nd, cfg.num_leaves), np.int64)
+    scalars = dict(
+        min_data=np.float32(cfg.min_data_in_leaf),
+        min_hess=np.float32(cfg.min_sum_hessian_in_leaf),
+        l1=np.float32(cfg.lambda_l1),
+        l2=np.float32(cfg.lambda_l2),
+    )
+    depth_limit = (
+        int(cfg.max_depth) if cfg.max_depth > 0 else cfg.num_leaves
+    )
+    grow_cfg = GrowConfig(
+        num_leaves=cfg.num_leaves,
+        max_depth=cfg.max_depth,
+        min_data_in_leaf=cfg.min_data_in_leaf,
+        min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+        lambda_l1=cfg.lambda_l1,
+        lambda_l2=cfg.lambda_l2,
+        min_gain_to_split=cfg.min_gain_to_split,
+        learning_rate=cfg.learning_rate,
+    )
+    dp_passes = _stream_metrics()["dp_passes"]
+
+    # per-class device gradient handles the shard_pass closure reads; the
+    # iteration loop rebinds them before each tree
+    gc_d: List[Any] = [None] * nd
+    hc_d: List[Any] = [None] * nd
+
+    def shard_pass(ids, member, feat, slot, new_slot, small_slot,
+                   route: bool):
+        """Dispatch the listed shards' route+hist kernels (queued async —
+        concurrent across devices on a pod), then reduce the fetched
+        histograms in FIXED shard-index order."""
+        ids = list(ids)
+        member = np.asarray(member, bool)
+        pending = []
+        for i in ids:
+            na, hist_i, cnt_i = route_hist_shard(
+                bins_d[i], gc_d[i], hc_d[i], mask_d[i], assign_d[i],
+                member, np.int32(feat), np.int32(slot),
+                np.int32(new_slot), np.int32(small_slot),
+                num_bins=num_bins, n_bins_static=n_bins_static,
+                hist_impl="einsum",
+            )
+            assign_d[i] = na
+            pending.append((i, hist_i, cnt_i))
+        acc = np.zeros((f, num_bins, 3), np.float32)
+        for i, hist_i, cnt_i in pending:  # shard-index order == ids order
+            acc += np.asarray(hist_i)
+            if route:
+                c2 = np.asarray(cnt_i)
+                counts[i, slot] = int(c2[0])
+                counts[i, new_slot] = int(c2[1])
+        dp_passes.inc(len(ids))
+        return acc
+
+    t_boost = time.perf_counter()
+    boost_span = tr.start_span(
+        "gbdt:boost_data_parallel",
+        attrs={"iterations": cfg.num_iterations, "rows": n_orig,
+               "features": f, "num_class": k, "shards": nd},
+    )
+    try:
+        for it in range(start_iter, start_iter + cfg.num_iterations):
+            t_round = time.perf_counter()
+            if use_bagging and it % max(1, cfg.bagging_freq) == 0:
+                bag_mask = train_rows & (bag_draw() < cfg.bagging_fraction)
+                mask_d = [
+                    upload_host_chunk(bag_mask[lo:hi], devices[i])
+                    for i, (lo, hi) in enumerate(bounds)
+                ]
+            if cfg.feature_fraction < 1.0:
+                n_keep = max(1, int(np.ceil(cfg.feature_fraction * f)))
+                keep = frng.choice(f, size=n_keep, replace=False)
+                fmask = np.zeros(f, bool)
+                fmask[keep] = True
+            else:
+                fmask = np.ones(f, bool)
+
+            g_d = [None] * nd
+            h_d = [None] * nd
+            for i in range(nd):
+                if w_d is None:
+                    g_d[i], h_d[i] = grad_fn(raw_d[i], y_d[i])
+                else:
+                    g_d[i], h_d[i] = grad_fn(raw_d[i], y_d[i], w_d[i])
+
+            for c in range(k):
+                for i in range(nd):
+                    if k > 1:
+                        gc_d[i] = take_class_column(g_d[i], col=c)
+                        hc_d[i] = take_class_column(h_d[i], col=c)
+                    else:
+                        gc_d[i], hc_d[i] = g_d[i], h_d[i]
+                    assign_d[i] = reset_assign(assign_d[i])
+                counts[:] = 0
+                counts[:, 0] = m
+                tree, leaf_vals = _grow_tree_hostdriven(
+                    shard_pass, counts, nd, f,
+                    n_bins_arr, cat_arr, fmask, scalars,
+                    num_bins, cfg.num_leaves, depth_limit,
+                    int(grow_cfg.max_cat_threshold),
+                    n_bins_static, cat_static,
+                    np.float32(cfg.learning_rate), grow_cfg, binner,
+                )
+                trees.append(tree)
+                for i in range(nd):
+                    if k > 1:
+                        raw_d[i] = add_leaf_outputs_col(
+                            raw_d[i], assign_d[i], leaf_vals, col=c
+                        )
+                    else:
+                        raw_d[i] = add_leaf_outputs(
+                            raw_d[i], assign_d[i], leaf_vals
+                        )
+            _record_boost_device_work(
+                "data_parallel", nd, time.perf_counter() - t_round, 1,
+                n_orig, f, num_bins, cfg.num_leaves, k,
+            )
+            if cfg.verbosity > 0 and (it % 10 == 0):
+                log.info("gbdt_dp_progress", iteration=it,
+                         trees=len(trees), shards=nd)
+    finally:
+        tr.end_span(boost_span)
+        phase_hist.labels(phase="boost_data_parallel").observe(
+            time.perf_counter() - t_boost
+        )
+
+    booster = Booster(
+        trees,
+        objective.kind,
+        num_class=getattr(objective, "num_class", 1),
+        init_score=np.atleast_1d(init_score),
+        feature_names=feature_names,
+        num_features=f,
+        avg_output=False,
+        objective_params=_objective_params(objective),
+    )
+    if _capture_resume_state:
+        raw_full = np.concatenate(
+            [np.asarray(r) for r in raw_d]
+        )[:n_orig]
+        booster._resume_capture = {
+            "raw": raw_full,
+            "rng_state": rng.bit_generator.state,
+            "frng_state": frng.bit_generator.state,
+            "bag_mask": (
+                np.asarray(bag_mask)[:n_orig] if use_bagging else None
+            ),
+        }
+    return booster
+
+
 def _gbdt_fingerprint(x: Optional[np.ndarray], y: np.ndarray,
                       objective: Objective,
                       cfg: TrainConfig,
@@ -1538,7 +2150,9 @@ def _gbdt_fingerprint(x: Optional[np.ndarray], y: np.ndarray,
                       init_model: Optional[Booster],
                       init_raw: Optional[np.ndarray],
                       stream_chunk_rows: int = 0,
-                      stream_bins_sha: Optional[str] = None) -> str:
+                      stream_bins_sha: Optional[str] = None,
+                      dp_shards: int = 0,
+                      stream_hist_impl: Optional[str] = None) -> str:
     """Identity of (config, data, weights, validation split, objective,
     warm-start inputs) a GBDT checkpoint may resume against. Data is
     sampled (64 rows) — cheap at 100M rows, still collision-proof against
@@ -1553,6 +2167,11 @@ def _gbdt_fingerprint(x: Optional[np.ndarray], y: np.ndarray,
     from mmlspark_tpu.io.checkpoint import fingerprint
 
     ident = dataclasses.asdict(cfg)
+    # the engine knob is NOT part of the data/model identity: it is popped
+    # so pre-PR15 stores keep resuming (their fingerprints predate the
+    # field). What IS identity-bearing about sharding — the accumulation
+    # partition — enters via dp_shards below, only when sharded.
+    ident.pop("engine", None)
     ident["categorical_indexes"] = list(ident["categorical_indexes"])
     ident["objective"] = objective.kind
     ident["num_class"] = getattr(objective, "num_class", 1)
@@ -1571,6 +2190,21 @@ def _gbdt_fingerprint(x: Optional[np.ndarray], y: np.ndarray,
         # reader-sourced fits have no x matrix to sample; the spilled-bin
         # row sample hashes the data identity instead
         ident["stream_bins_sha"] = stream_bins_sha
+    if dp_shards > 1:
+        # sharded in-memory fits reduce histograms in fixed shard order, so
+        # the shard count IS the accumulation-order identity — resuming a
+        # sharded store on a different mesh size could flip f32 near-ties
+        # mid-ensemble. Unsharded (and streamed: chunk order is
+        # nd-independent) fits keep their pre-PR15 fingerprints.
+        ident["dp_shards"] = int(dp_shards)
+    if stream_hist_impl and stream_hist_impl != "einsum":
+        # streamed pallas (single-device TPU) histograms differ from the
+        # einsum path in f32 ulps, so a pallas-grown store must refuse to
+        # resume onto einsum segments (and vice versa: a pre-PR15 einsum
+        # store resumed on a now-pallas chip mismatches here instead of
+        # silently mixing kernels mid-ensemble). einsum stores keep their
+        # pre-PR15 fingerprints.
+        ident["stream_hist_impl"] = stream_hist_impl
     # warm-start keys enter the ident only when present: a plain fit's
     # fingerprint stays byte-identical to stores written before these
     # inputs were covered, so existing checkpoints keep resuming — while
@@ -1605,6 +2239,7 @@ def _train_booster_checkpointed(
     checkpoint_keep_last: int,
     stream_chunk_rows: int = 0,
     _stream_data: Optional[_StreamData] = None,
+    _engine_auto: bool = False,
 ) -> Booster:
     """Boosting driven in `checkpoint_every`-iteration segments, each
     committing to a crash-consistent CheckpointStore; a resumed fit grows
@@ -1616,6 +2251,13 @@ def _train_booster_checkpointed(
     engine over ONE shared prepared spill (binned once, never re-binned
     per segment); the fingerprint then also carries the chunk size, since
     streamed fits are bit-reproducible only at their own chunk size.
+
+    `_engine_auto` marks that the pinned engine came from engine="auto"
+    rather than an explicit request: when an auto-picked data_parallel fit
+    finds a store written by the fused engine (every pre-PR15 store — the
+    old auto default), it falls back to fused for the WHOLE fit and
+    resumes bit-identically instead of refusing on the dp_shards
+    fingerprint key. An explicit engine= request never silently switches.
     """
     import json
 
@@ -1658,11 +2300,23 @@ def _train_booster_checkpointed(
         )
     if data is not None and not stream_chunk_rows:
         stream_chunk_rows = data.chunk_rows  # chunk size IS the identity
+    dp_shards = 0
+    if cfg.engine == "data_parallel" and data is None:
+        # the engine was pinned at the outermost train_booster entry, so
+        # every segment of this fit shards the same way; streamed fits
+        # accumulate in chunk order (nd-independent) and carry no shard key
+        import jax
+
+        dp_shards = jax.device_count()
     fingerprint = _gbdt_fingerprint(
         x, y, objective, cfg, sample_weight, valid_mask, init_model,
         init_raw, stream_chunk_rows=stream_chunk_rows,
         stream_bins_sha=(data.bins_sample_sha
                          if x is None and data is not None else None),
+        dp_shards=dp_shards,
+        stream_hist_impl=(
+            _stream_hist_impl(cfg.engine) if data is not None else None
+        ),
     )
 
     try:
@@ -1671,13 +2325,36 @@ def _train_booster_checkpointed(
         done = 0
         ck = store.load_latest()
         if ck is not None:
+            if (
+                ck.meta.get("fingerprint") != fingerprint
+                and _engine_auto and dp_shards > 1
+            ):
+                # auto-picked data_parallel meeting a store the FUSED
+                # engine wrote (every pre-PR15 store: dp_shards absent
+                # from its fingerprint): resume on fused for the whole
+                # fit — bit-identical continuation of the old trajectory
+                # — rather than refusing under an unchanged user config.
+                legacy = _gbdt_fingerprint(
+                    x, y, objective, cfg, sample_weight, valid_mask,
+                    init_model, init_raw,
+                    stream_chunk_rows=stream_chunk_rows,
+                )
+                if ck.meta.get("fingerprint") == legacy:
+                    log.info(
+                        "gbdt_resume_engine_fallback",
+                        store_engine="fused", pinned="data_parallel",
+                    )
+                    cfg = dataclasses.replace(cfg, engine="fused")
+                    fingerprint = legacy
             if ck.meta.get("fingerprint") != fingerprint:
                 raise ValueError(
                     f"checkpoint store {checkpoint_dir!r} was written by a "
                     "different GBDT/data configuration (fingerprint "
-                    "mismatch). Pass a fresh checkpoint_dir, delete the "
-                    "stale store, or restore the original configuration to "
-                    "resume it."
+                    "mismatch — for sharded fits this includes the engine "
+                    "and mesh size; engine='fused' resumes a pre-sharding "
+                    "store explicitly). Pass a fresh checkpoint_dir, "
+                    "delete the stale store, or restore the original "
+                    "configuration to resume it."
                 )
             booster = Booster.from_string(ck.text("model.txt"))
             state = ck.json("state.json")

@@ -76,12 +76,16 @@ class ColumnChunk:
     ``columns`` values are 1-D arrays (or a single 2-D feature block from
     `ArrayReader`); ``index`` is the global chunk ordinal of this pass —
     the fixed accumulation order streamed consumers key on.
+    ``shard_index`` is the ordinal of the shard this chunk came from —
+    the unit the sharded ingestion tier assigns device ownership by
+    (`round_robin_owners`; docs/dataplane.md "Sharded ingestion").
     """
 
     columns: Dict[str, np.ndarray]
     shard: str
     index: int
     rows: int
+    shard_index: int = 0
 
     def matrix(self, feature_cols: Sequence[str],
                dtype: Any = np.float32) -> np.ndarray:
@@ -123,6 +127,12 @@ class ShardReader:
         """Total rows, when knowable without reading data (Parquet footers,
         npy headers, array shapes); None for opaque sources."""
         return None
+
+    @property
+    def num_shards(self) -> int:
+        """How many shards back this reader (1 for in-memory sources) —
+        the unit count `round_robin_owners` maps onto mesh devices."""
+        return 1
 
     @property
     def column_names(self) -> List[str]:
@@ -205,11 +215,15 @@ class ParquetShardReader(ShardReader):
         pq = self._pq()
         return list(pq.ParquetFile(self.paths[0]).schema_arrow.names)
 
+    @property
+    def num_shards(self) -> int:
+        return len(self.paths)
+
     def iter_chunks(self) -> Iterator[ColumnChunk]:
         pq = self._pq()
         m = _metrics()
         index = 0
-        for path in self.paths:
+        for si, path in enumerate(self.paths):
             shard_s = 0.0
             t0 = time.perf_counter()
             pf = pq.ParquetFile(path)
@@ -223,7 +237,7 @@ class ParquetShardReader(ShardReader):
                 }
                 now = time.perf_counter()
                 shard_s += now - t0
-                chunk = ColumnChunk(cols, path, index, batch.num_rows)
+                chunk = ColumnChunk(cols, path, index, batch.num_rows, si)
                 _record_chunk(self.format, chunk)
                 yield chunk
                 index += 1
@@ -273,10 +287,14 @@ class NumpyShardReader(ShardReader):
     def column_names(self) -> List[str]:
         return list(self.columns)
 
+    @property
+    def num_shards(self) -> int:
+        return len(self.shards)
+
     def iter_chunks(self) -> Iterator[ColumnChunk]:
         m = _metrics()
         index = 0
-        for shard in self.shards:
+        for si, shard in enumerate(self.shards):
             shard_s = 0.0
             t0 = time.perf_counter()
             mms = {c: np.load(shard[c], mmap_mode="r") for c in self.columns}
@@ -289,7 +307,7 @@ class NumpyShardReader(ShardReader):
                 cols = {c: np.array(mm[lo:hi]) for c, mm in mms.items()}
                 now = time.perf_counter()
                 shard_s += now - t0
-                chunk = ColumnChunk(cols, name, index, hi - lo)
+                chunk = ColumnChunk(cols, name, index, hi - lo, si)
                 _record_chunk(self.format, chunk)
                 yield chunk
                 index += 1
@@ -415,13 +433,29 @@ def write_parquet_shards(
     return ParquetShardReader(paths)
 
 
+def round_robin_owners(num_units: int, devices: Sequence[Any]) -> List[Any]:
+    """FIXED round-robin unit->device ownership for sharded ingestion:
+    unit i (a reader shard, or a streamed GBDT spill chunk) belongs to
+    ``devices[i % len(devices)]`` for the whole fit — deterministic, so
+    every pass over the stream places the same rows on the same chip, and
+    on a pod each host's reader feeds its own devices. Used with
+    ``DeviceChunkPrefetcher(placement=...)``: the staged chunk's rows are
+    uploaded straight onto their owner (docs/dataplane.md "Sharded
+    ingestion")."""
+    if not devices:
+        raise ValueError("round_robin_owners needs at least one device")
+    return [devices[i % len(devices)] for i in range(int(num_units))]
+
+
 def open_shards(
     paths: Union[str, Sequence[str]],
     columns: Optional[Sequence[str]] = None,
     chunk_rows: int = DEFAULT_CHUNK_ROWS,
 ) -> ShardReader:
     """Reader by extension: ``.parquet`` shards -> ParquetShardReader,
-    ``.npy`` shard layouts -> NumpyShardReader."""
+    ``.npy`` shard layouts -> NumpyShardReader. Mesh consumers map the
+    reader's shards onto devices with ``round_robin_owners`` (the sharded
+    streaming ingestion tier)."""
     probe = _expand_paths(paths, ".parquet")
     if probe and all(p.endswith(".parquet") for p in probe):
         return ParquetShardReader(probe, columns, chunk_rows)

@@ -21,6 +21,7 @@ from mmlspark_tpu.parallel.mesh import (
     pad_to_multiple,
     replicated_sharding,
     shard_batch,
+    shard_target_rows,
 )
 
 __all__ = [
@@ -30,4 +31,5 @@ __all__ = [
     "pad_to_multiple",
     "replicated_sharding",
     "shard_batch",
+    "shard_target_rows",
 ]

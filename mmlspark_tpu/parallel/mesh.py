@@ -63,17 +63,44 @@ def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0) -> Tuple[np.n
     return np.concatenate([arr, pad_block], axis=axis), n
 
 
+def shard_target_rows(n: int, n_data: int) -> int:
+    """The padded row count shard_batch uploads at: the power-of-two
+    dispatch bucket (core/dispatch.bucket_rows — the PR 3 compile-capping
+    discipline) rounded up to a data-axis multiple (XLA's equal-slice
+    requirement). Ragged serving traffic thus compiles ONE program per
+    bucket instead of one per distinct batch size. The dispatch
+    `bucketing(False)` rollback lever applies here too: disabled, the pad
+    reverts to the minimal data-axis multiple."""
+    if n <= 0:
+        return n_data
+    from mmlspark_tpu.core import dispatch
+
+    target = (
+        max(dispatch.bucket_rows(n), n_data)
+        if dispatch.bucketing_enabled() else n
+    )
+    if target % n_data:
+        target += n_data - target % n_data
+    return target
+
+
 def shard_batch(mesh, arr: np.ndarray):
-    """Host array -> device array sharded along "data". Pads the batch to the
-    data-axis size so every chip gets an equal slice (XLA requirement), and
-    returns (sharded_array, original_length). The upload is counted in
-    profiling.dataplane_counters()."""
+    """Host array -> device array sharded along "data". Pads the batch up
+    to the shape-bucketed data-axis multiple (shard_target_rows) through
+    the SHARED dispatch pad helper — core/dispatch.pad_rows, whose device
+    path is a compiled program — so every chip gets an equal slice (XLA
+    requirement) and non-divisible row counts stop minting one compiled
+    shape per distinct batch size downstream. Returns (sharded_array,
+    original_length); callers trim with core/dispatch.trim_rows (also
+    compiled). The upload is counted in profiling.dataplane_counters()."""
     import jax
 
+    from mmlspark_tpu.core.dispatch import pad_rows
     from mmlspark_tpu.utils.profiling import dataplane_counters
 
     n_data = mesh.shape[DATA_AXIS]
-    padded, n = pad_to_multiple(np.asarray(arr), n_data, axis=0)
+    arr = np.asarray(arr)
+    padded, n = pad_rows(arr, shard_target_rows(arr.shape[0], n_data))
     sharding = batch_sharding(mesh, ndim=padded.ndim)
     dataplane_counters().record_h2d(padded.nbytes)
     return jax.device_put(padded, sharding), n
@@ -99,9 +126,12 @@ def shard_frame(mesh, df, columns: Optional[Sequence[str]] = None):
     code changes. Non-numeric (object-dtype) columns pass through host-side.
 
     Ragged serving batch sizes rarely divide the data axis, so host columns
-    go through shard_batch (pad to a data-axis multiple, XLA's divisibility
-    requirement) and are trimmed back on device — the trim is a compiled
-    static-bound slice, so no row count ever round-trips through host."""
+    go through shard_batch (pad to the shape-BUCKETED data-axis multiple
+    via the shared core/dispatch pad_rows helper — one compiled shape per
+    bucket, XLA's divisibility requirement met) and are trimmed back on
+    device — the trim is the compiled static-bound dispatch slice
+    (core/dispatch.trim_rows), so no row count ever round-trips through
+    host."""
     from mmlspark_tpu.core.dataframe import DataType
     from mmlspark_tpu.core.dispatch import trim_rows
 

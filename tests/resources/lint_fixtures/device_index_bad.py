@@ -1,0 +1,69 @@
+"""Fixture for the hardcoded-device-index rule: scalar subscripts of
+jax.devices()/jax.local_devices() pinning work to one device. Parsed,
+never imported."""
+
+import jax
+import numpy as np
+
+
+def pins_first_device(arr):
+    dev = jax.devices()[0]  # expect[hardcoded-device-index]
+    return jax.device_put(arr, dev)
+
+
+def pins_local_device(arr):
+    return jax.device_put(arr, jax.local_devices()[0])  # expect[hardcoded-device-index]
+
+
+def pins_through_alias(arr):
+    devs = jax.devices()
+    return jax.device_put(arr, devs[0])  # expect[hardcoded-device-index]
+
+
+def pins_nonzero_index(arr, i):
+    return jax.device_put(arr, jax.devices()[i])  # expect[hardcoded-device-index]
+
+
+def guarded_single_device(arr):
+    # explicitly single-device-guarded branch: one device is all there is
+    if jax.device_count() == 1:
+        return jax.device_put(arr, jax.devices()[0])
+    return arr
+
+
+def guarded_by_len_probe(arr):
+    if len(jax.devices()) <= 1:
+        return jax.device_put(arr, jax.devices()[0])
+    return arr
+
+
+def else_branch_is_not_guarded(arr):
+    if jax.device_count() == 1:
+        return arr
+    else:
+        return jax.device_put(arr, jax.devices()[0])  # expect[hardcoded-device-index]
+
+
+def multi_device_branch_is_not_guarded(arr):
+    # the test PROBES the count but guards the MULTI-device side — pinning
+    # device 0 here is exactly the bug class the rule exists for
+    if jax.device_count() > 1:
+        return jax.device_put(arr, jax.devices()[0])  # expect[hardcoded-device-index]
+    return arr
+
+
+def reversed_constant_guard_ok(arr):
+    if 1 == jax.device_count():
+        return jax.device_put(arr, jax.devices()[0])
+    return arr
+
+
+def prefix_slice_selects_device_set(shape):
+    # sanctioned idiom: a prefix SLICE picks the device set for a mesh
+    return jax.devices()[: int(np.prod(shape))]
+
+
+def justified_kind_probe():
+    # homogeneous-pod device-kind probe, justified and suppressed
+    kind = jax.devices()[0].device_kind  # graftcheck: ignore[hardcoded-device-index]  # expect-suppressed[hardcoded-device-index]
+    return kind

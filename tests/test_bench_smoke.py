@@ -41,6 +41,7 @@ OUT8 = os.path.join(REPO, "BENCH_pr08.json")
 OUT9 = os.path.join(REPO, "BENCH_pr09.json")
 OUT13 = os.path.join(REPO, "BENCH_pr13.json")
 OUT14 = os.path.join(REPO, "BENCH_pr14.json")
+OUT15 = os.path.join(REPO, "BENCH_pr15.json")
 
 
 def _assert_provenance(report):
@@ -595,3 +596,65 @@ def test_clobber_guard_refuses_failing_round(tmp_path, monkeypatch):
     bench._write_report({"anything": 1}, other)
     with open(other) as f:
         assert json.load(f)["anything"] == 1
+
+
+def test_sharded_gbdt_smoke_gates():
+    """ISSUE 15 acceptance, through the product path (no mocks):
+
+    - hist-pass throughput: on the 8-device CPU mesh, the data-parallel
+      engine's boosting-loop wall (jit pre-warmed, gbdt_phase_seconds)
+      is >= 4x faster than the single-device fused fit at the same fixed
+      dataset — per-shard leaf skipping + small-child-only passes on this
+      single-core box; concurrent per-chip dispatch on a real pod;
+    - determinism contract: the sharded fit is BIT-IDENTICAL to the
+      single-device fused fit (the explicit fixed-shard-order reduction),
+      and reruns are bit-identical — both comparisons are deterministic
+      (no timing noise), so they gate exactly on every round;
+    - resident transfer discipline: counted uploads for the dp fit are
+      exactly shards x payload leaves (row data uploads once per fit —
+      zero per-row/per-pass h2d);
+    - streamed-sharded: peak RSS stays within the PR 9 single-stream
+      bound (<= 0.5x in-memory), uploads == payload leaves x chunk
+      visits, chunks place across all 8 owner devices;
+    - PR 8 composition: a sharded fit killed at a checkpoint boundary
+      resumes bit-identically.
+
+    The throughput ratio is the one wall-clock-dependent gate on a shared
+    CI box, so the measurement retries up to 3 times and gates on any
+    clean round; parity/transfer/footprint gates are exact or
+    allocation-deterministic and must hold every round."""
+    import bench
+
+    def clean(r):
+        return r["throughput"]["ratio_vs_fused"] >= 4.0
+
+    for attempt in range(3):
+        report = bench.run_sharded_gbdt_smoke(OUT15)
+        assert not report.get("skipped"), report
+        assert report["n_devices"] == 8, report
+        # exact gates: every round, no retry absolution
+        p = report["parity"]
+        assert p["trees_bit_identical"], p
+        assert p["determinism_delta"] == 0.0, p
+        tx = report["transfers_dp"]
+        assert tx["resident_uploads"] == tx["expected_resident_uploads"], tx
+        assert not tx["per_row_h2d"], tx
+        s = report["streamed_sharded"]
+        assert s["peak_ratio"] <= 0.5, s
+        assert s["uploads_per_visit"] == float(s["payload_leaves"]), s
+        assert not s["per_row_h2d"], s
+        assert s["owner_devices"] == 8, s
+        ck = report["checkpoint_compose"]
+        assert ck["killed_mid_fit"] and ck["resume_identical"], ck
+        _assert_provenance(report)
+        if clean(report):
+            break
+
+    assert report["throughput"]["ratio_vs_fused"] >= 4.0, report["throughput"]
+
+    # the artifact the driver reads
+    with open(OUT15) as f:
+        on_disk = json.load(f)
+    assert on_disk["parity"]["trees_bit_identical"] is True
+    assert on_disk["throughput"]["ratio_vs_fused"] >= 4.0
+    assert on_disk["checkpoint_compose"]["resume_identical"] is True

@@ -541,15 +541,22 @@ class TestTrainingDeviceMetrics:
         rng = np.random.default_rng(0)
         x = rng.normal(size=(256, 4))
         y = (x[:, 0] + x[:, 1] > 0).astype(np.float64)
+        import jax
+
         hist = registry().histogram(
-            "gbdt_round_device_seconds", "", ("engine",)
+            "gbdt_round_device_seconds", "", ("engine", "shards")
         )
-        before = hist.labels(engine="fused").count()
+        # the fused engine GSPMD-shards over every device (8 in the test
+        # env); the round metric's shards label records that
+        shards = str(jax.device_count())
+        before = hist.labels(engine="fused", shards=shards).count()
         train_booster(
             x, y, make_objective("binary"),
             TrainConfig(num_iterations=3, num_leaves=7, verbosity=0),
         )
-        assert hist.labels(engine="fused").count() == before + 1
+        assert hist.labels(
+            engine="fused", shards=shards
+        ).count() == before + 1
         assert registry().gauge(
             "device_mfu", "", ("model",)
         ).labels(model="gbdt").value() > 0

@@ -1,0 +1,559 @@
+"""Mesh-sharded data-parallel GBDT (ISSUE 15): the 8-way CPU-mesh parity
+suite.
+
+The determinism contract under test: the data-parallel engine shards rows
+over devices, builds per-device histograms, and reduces them in FIXED
+shard order (an explicit segment reduction, not a psum) — so sharded fits
+are bit-identical to the single-device FUSED fit at smoke scale (binary,
+multiclass, bagging/feature-fraction), reruns are bit-identical, sharded
+streaming is bit-identical to single-device streaming, and PR 8
+checkpointing composes (kill at a boundary, resume bit-identical).
+Everything asserts through model_to_string() — the strictest equality the
+persistence format offers.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.gbdt import LightGBMClassifier
+from mmlspark_tpu.gbdt import trainer as trainer_mod
+from mmlspark_tpu.gbdt.objectives import make_objective
+from mmlspark_tpu.gbdt.trainer import TrainConfig, train_booster
+from mmlspark_tpu.obs.metrics import registry
+
+_CFG = dict(num_iterations=4, num_leaves=7, max_bin=31, verbosity=0,
+            categorical_indexes=[2])
+
+
+def _data(n=2048, seed=0, F=6):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, F))
+    x[:, 2] = rng.integers(0, 5, n)
+    y = (
+        (x[:, 0] + 0.5 * x[:, 1] - x[:, 3] ** 2
+         + rng.normal(scale=0.3, size=n)) > 0
+    ).astype(np.float64)
+    return x, y
+
+
+def _fused_single(x, y, obj, cfg, **kw):
+    """The single-device fused reference fit (the bit-parity target)."""
+    trainer_mod._FORCE_SINGLE_DEVICE = True
+    try:
+        return train_booster(
+            x, y, obj, dataclasses.replace(cfg, engine="fused"), **kw
+        )
+    finally:
+        trainer_mod._FORCE_SINGLE_DEVICE = False
+
+
+def _dp(cfg):
+    return dataclasses.replace(cfg, engine="data_parallel")
+
+
+class TestDataParallelParity:
+    def test_binary_bit_identical_and_deterministic(self):
+        import jax
+
+        assert jax.device_count() == 8  # conftest forces the 8-way mesh
+        x, y = _data()
+        cfg = TrainConfig(**_CFG)
+        obj = make_objective("binary", num_class=2)
+        ref = _fused_single(x, y, obj, cfg)
+        a = train_booster(x, y, obj, _dp(cfg))
+        b = train_booster(x, y, obj, _dp(cfg))
+        assert a.model_to_string() == ref.model_to_string()
+        assert a.model_to_string() == b.model_to_string()
+
+    def test_odd_rows_pad_path_bit_identical(self):
+        # 2049 rows: shards pad with masked-out zero-weight rows, which
+        # contribute exactly 0.0f to every histogram cell
+        x, y = _data(2049, seed=3)
+        cfg = TrainConfig(**{**_CFG, "num_iterations": 3})
+        obj = make_objective("binary", num_class=2)
+        ref = _fused_single(x, y, obj, cfg)
+        a = train_booster(x, y, obj, _dp(cfg))
+        assert a.model_to_string() == ref.model_to_string()
+
+    def test_multiclass_bit_identical(self):
+        x, y = _data(seed=5)
+        yy = np.minimum(2, y + (x[:, 1] > 0)).astype(np.float64)
+        cfg = TrainConfig(**{**_CFG, "num_iterations": 3})
+        obj = make_objective("multiclass", num_class=3)
+        ref = _fused_single(x, yy, obj, cfg)
+        a = train_booster(x, yy, obj, _dp(cfg))
+        assert a.model_to_string() == ref.model_to_string()
+
+    def test_bagging_feature_fraction_bit_identical(self):
+        # rng draw sequences replicate the fused engine's 1024-quantized
+        # host draws, so sampled fits shard bit-identically too
+        x, y = _data(seed=7)
+        cfg = TrainConfig(bagging_fraction=0.7, bagging_freq=2,
+                          feature_fraction=0.8, **_CFG)
+        obj = make_objective("binary", num_class=2)
+        ref = _fused_single(x, y, obj, cfg)
+        a = train_booster(x, y, obj, _dp(cfg))
+        assert a.model_to_string() == ref.model_to_string()
+
+    def test_weighted_fit_bit_identical(self):
+        x, y = _data(seed=11)
+        w = np.random.default_rng(2).random(len(y)) + 0.5
+        cfg = TrainConfig(**{**_CFG, "num_iterations": 3})
+        obj = make_objective("binary", num_class=2)
+        ref = _fused_single(x, y, obj, cfg, sample_weight=w)
+        a = train_booster(x, y, obj, _dp(cfg), sample_weight=w)
+        assert a.model_to_string() == ref.model_to_string()
+
+
+class TestShardedStreaming:
+    def test_streamed_sharded_matches_streamed(self):
+        """Chunk->device round-robin placement changes WHERE each chunk's
+        kernel runs, never the chunk-order accumulation — so sharded
+        streaming is bit-identical to single-device streaming."""
+        x, y = _data(1536, seed=9)
+        obj = make_objective("binary", num_class=2)
+        cfg = TrainConfig(**_CFG)
+        # engine=fused pins the unsharded streamed path; data_parallel
+        # round-robins chunk ownership over the 8-device mesh
+        plain = train_booster(
+            x, y, obj, dataclasses.replace(cfg, engine="fused"),
+            stream_chunk_rows=300,
+        )
+        sharded = train_booster(
+            x, y, obj, _dp(cfg), stream_chunk_rows=300
+        )
+        assert sharded.model_to_string() == plain.model_to_string()
+
+    def test_round_robin_owner_map(self):
+        import jax
+
+        from mmlspark_tpu.io.columnar import round_robin_owners
+
+        devs = jax.devices()
+        owners = round_robin_owners(11, devs)
+        assert owners == [devs[i % len(devs)] for i in range(11)]
+        with pytest.raises(ValueError, match="device"):
+            round_robin_owners(4, [])
+
+    def test_reader_shard_index_provenance(self, tmp_path):
+        from mmlspark_tpu.io.columnar import write_numpy_shards
+
+        cols = {"a": np.arange(10.0), "b": np.arange(10.0) * 2}
+        reader = write_numpy_shards(str(tmp_path / "s"), cols, 4)
+        reader.chunk_rows = 2
+        assert reader.num_shards == 3
+        seen = [(c.index, c.shard_index) for c in reader.iter_chunks()]
+        # 3 shards of (4, 4, 2) rows, 2-row chunks -> shard ordinals
+        assert seen == [(0, 0), (1, 0), (2, 1), (3, 1), (4, 2)]
+
+    def test_reader_fit_owns_chunks_by_source_shard(self, tmp_path):
+        """Reader-sourced sharded fits assign device ownership by SOURCE
+        SHARD (all of one shard's chunks on one device — the per-host-
+        reader layout), carried through _StreamData.chunk_shards; and the
+        sharded reader fit stays bit-identical to the unsharded one."""
+        from mmlspark_tpu.gbdt.trainer import (
+            _prepare_stream_from_reader,
+            train_booster_from_reader,
+        )
+        from mmlspark_tpu.io.columnar import write_numpy_shards
+
+        x, y = _data(1200, seed=21)
+        cols = {f"f{j}": x[:, j] for j in range(x.shape[1])}
+        cols["label"] = y
+        reader = write_numpy_shards(str(tmp_path / "s"), cols, 400)
+        reader.chunk_rows = 200
+        cfg = TrainConfig(**{**_CFG, "num_iterations": 2,
+                             "categorical_indexes": []})
+        obj = make_objective("binary", num_class=2)
+        data = _prepare_stream_from_reader(
+            reader, [f"f{j}" for j in range(x.shape[1])], "label", None,
+            cfg,
+        )
+        try:
+            # 3 shards x 2 chunks each -> shard ordinal per spill chunk
+            assert data.chunk_shards == [0, 0, 1, 1, 2, 2]
+        finally:
+            data.cleanup()
+        sharded = train_booster_from_reader(
+            reader, [f"f{j}" for j in range(x.shape[1])], obj, _dp(cfg)
+        )
+        plain = train_booster_from_reader(
+            reader, [f"f{j}" for j in range(x.shape[1])], obj,
+            dataclasses.replace(cfg, engine="fused"),
+        )
+        assert sharded.model_to_string() == plain.model_to_string()
+
+    def test_streamed_fingerprint_carries_pallas_only(self):
+        """A pallas-grown streamed store must not resume onto einsum
+        segments (the kernels differ in f32 ulps); einsum stores keep
+        their pre-PR15 fingerprints."""
+        from mmlspark_tpu.gbdt.trainer import _gbdt_fingerprint
+
+        x, y = _data(512, seed=25)
+        obj = make_objective("binary", num_class=2)
+        cfg = TrainConfig(verbosity=0)
+        einsum_fp = _gbdt_fingerprint(
+            x, y, obj, cfg, None, None, None, None,
+            stream_chunk_rows=128, stream_hist_impl="einsum",
+        )
+        legacy_fp = _gbdt_fingerprint(
+            x, y, obj, cfg, None, None, None, None, stream_chunk_rows=128,
+        )
+        pallas_fp = _gbdt_fingerprint(
+            x, y, obj, cfg, None, None, None, None,
+            stream_chunk_rows=128, stream_hist_impl="pallas",
+        )
+        assert einsum_fp == legacy_fp  # einsum stores stay resumable
+        assert pallas_fp != einsum_fp
+
+
+class TestShardedPrefetcher:
+    def test_placement_uploads_to_owner_devices_and_counts(self):
+        import jax
+
+        from mmlspark_tpu.core.prefetch import DeviceChunkPrefetcher
+        from mmlspark_tpu.io.columnar import round_robin_owners
+        from mmlspark_tpu.utils.profiling import dataplane_counters
+
+        devs = jax.devices()
+        owners = round_robin_owners(8, devs)
+        before = dataplane_counters().snapshot()
+        got = []
+        with DeviceChunkPrefetcher(
+            iter(range(8)),
+            lambda i: {"bins": np.full((16, 2), i, np.uint8),
+                       "g": np.ones(16, np.float32)},
+            placement=lambda i: owners[i],
+        ) as pf:
+            for i, dev in enumerate(pf):
+                got.append(dev)
+                # every leaf of chunk i lives on its owning device
+                for leaf in dev.values():
+                    assert list(leaf.devices()) == [owners[i]]
+        delta = dataplane_counters().delta(before)
+        assert delta["h2d_transfers"] == 8 * 2  # 2 leaves per chunk
+        assert {list(d["bins"].devices())[0] for d in got} == set(devs)
+
+    def test_placement_close_unblocks_parked_consumer(self):
+        import threading
+
+        import jax
+
+        from mmlspark_tpu.core.prefetch import DeviceChunkPrefetcher
+
+        devs = jax.devices()
+        release = threading.Event()
+
+        def slow_stage(i):
+            if i >= 2:
+                release.wait(timeout=5.0)
+            return np.ones(8, np.float32)
+
+        pf = DeviceChunkPrefetcher(
+            iter(range(4)), slow_stage, depth=1,
+            placement=lambda i: devs[i % len(devs)],
+        )
+        it = iter(pf)
+        next(it)
+        closer = threading.Timer(0.2, pf.close)
+        closer.start()
+        try:
+            # the producer is parked staging chunk 2; close() must
+            # unblock this consumer rather than leave it waiting forever
+            drained = 0
+            try:
+                while True:
+                    next(it)
+                    drained += 1
+            except StopIteration:
+                pass
+            assert drained <= 3
+        finally:
+            release.set()
+            closer.cancel()
+            pf.close()
+
+
+class TestEngineSelection:
+    def test_auto_picks_data_parallel_above_threshold(self, monkeypatch):
+        monkeypatch.setattr(trainer_mod, "_DP_AUTO_MIN_ROWS", 512)
+        x, y = _data(1024, seed=1)
+        cfg = TrainConfig(**{**_CFG, "num_iterations": 2})
+        obj = make_objective("binary", num_class=2)
+        phase = registry().histogram(
+            "gbdt_phase_seconds", "", ("phase",)
+        )
+        before = phase.labels(phase="boost_data_parallel").count()
+        train_booster(x, y, obj, cfg)  # engine defaults to auto
+        assert phase.labels(phase="boost_data_parallel").count() == before + 1
+
+    def test_fused_rollback_lever(self, monkeypatch):
+        monkeypatch.setattr(trainer_mod, "_DP_AUTO_MIN_ROWS", 512)
+        x, y = _data(1024, seed=1)
+        cfg = TrainConfig(**{**_CFG, "num_iterations": 2, "engine": "fused"})
+        obj = make_objective("binary", num_class=2)
+        phase = registry().histogram(
+            "gbdt_phase_seconds", "", ("phase",)
+        )
+        before = phase.labels(phase="boost_data_parallel").count()
+        train_booster(x, y, obj, cfg)
+        assert phase.labels(phase="boost_data_parallel").count() == before
+
+    def test_auto_small_fit_stays_fused(self):
+        x, y = _data(256, seed=2)
+        cfg = TrainConfig(**{**_CFG, "num_iterations": 2})
+        obj = make_objective("binary", num_class=2)
+        phase = registry().histogram(
+            "gbdt_phase_seconds", "", ("phase",)
+        )
+        before = phase.labels(phase="boost_data_parallel").count()
+        train_booster(x, y, obj, cfg)
+        assert phase.labels(phase="boost_data_parallel").count() == before
+
+    def test_explicit_engine_guards(self):
+        x, y = _data(512, seed=4)
+        obj = make_objective("binary", num_class=2)
+        for kw, match in (
+            (dict(boosting_type="rf"), "rf"),
+            (dict(boosting_type="dart"), "dart"),
+            (dict(boosting_type="goss"), "goss"),
+            (dict(early_stopping_round=3), "validation"),
+        ):
+            cfg = TrainConfig(verbosity=0, engine="data_parallel", **kw)
+            with pytest.raises(ValueError, match=match.split("_")[0]):
+                train_booster(x, y, obj, cfg)
+        cfg = TrainConfig(verbosity=0, engine="data_parallel")
+        with pytest.raises(ValueError, match="validation"):
+            train_booster(x, y, obj, cfg,
+                          valid_mask=np.zeros(len(y), bool))
+        with pytest.raises(ValueError, match="init_score"):
+            train_booster(x, y, obj, cfg, init_raw=np.zeros(len(y)))
+        with pytest.raises(ValueError, match="engine"):
+            train_booster(
+                x, y, obj, TrainConfig(verbosity=0, engine="warp"),
+            )
+
+    def test_auto_falls_back_for_unsupported_modes(self, monkeypatch):
+        # dart at any size auto-resolves fused (no guard explosion)
+        monkeypatch.setattr(trainer_mod, "_DP_AUTO_MIN_ROWS", 64)
+        x, y = _data(512, seed=4)
+        obj = make_objective("binary", num_class=2)
+        cfg = TrainConfig(boosting_type="dart", **_CFG)
+        b = train_booster(x, y, obj, cfg)
+        assert len(b.trees) == _CFG["num_iterations"]
+
+    def test_estimator_engine_param_bit_identical(self):
+        x, y = _data(seed=13)
+        df = DataFrame.from_dict({"features": x, "label": y})
+        kw = dict(num_iterations=3, num_leaves=7, max_bin=31, verbosity=0,
+                  categorical_slot_indexes=[2])
+        trainer_mod._FORCE_SINGLE_DEVICE = True
+        try:
+            ref = LightGBMClassifier(engine="fused", **kw).fit(df)
+        finally:
+            trainer_mod._FORCE_SINGLE_DEVICE = False
+        dp = LightGBMClassifier(engine="data_parallel", **kw).fit(df)
+        assert (
+            dp.get_booster().model_to_string()
+            == ref.get_booster().model_to_string()
+        )
+
+
+class TestCheckpointCompose:
+    def test_dp_kill_at_boundary_resume_bit_identical(self, tmp_path):
+        """ISSUE 15 acceptance: the sharded engine composes with PR 8
+        checkpointing — kill -9 right after the first commit, resume, and
+        the finished ensemble is bit-identical to the uninterrupted fit."""
+        from mmlspark_tpu.io.storage_faults import (
+            InjectedCrash,
+            StorageFaultInjector,
+            installed,
+        )
+
+        x, y = _data(seed=17)
+        cfg = _dp(TrainConfig(bagging_fraction=0.8, bagging_freq=2, **_CFG))
+        obj = make_objective("binary", num_class=2)
+
+        def fit(ck=None):
+            return train_booster(x, y, obj, cfg, checkpoint_dir=ck,
+                                 checkpoint_every=2)
+
+        base = fit()
+        plain = train_booster(x, y, obj, cfg)
+        assert base.model_to_string() == plain.model_to_string()
+
+        inj = StorageFaultInjector()
+        inj.crash_after_rename(nth=1)
+        killed = False
+        kd = str(tmp_path / "kill")
+        try:
+            with installed(inj):
+                fit(kd)
+        except InjectedCrash:
+            killed = True
+        assert killed
+        resumed = fit(kd)
+        assert resumed.model_to_string() == base.model_to_string()
+
+    def test_fingerprint_carries_shard_count_only_when_sharded(self):
+        import jax
+
+        from mmlspark_tpu.gbdt.trainer import _gbdt_fingerprint
+
+        x, y = _data(512, seed=19)
+        obj = make_objective("binary", num_class=2)
+        cfg = TrainConfig(verbosity=0)
+        base = _gbdt_fingerprint(x, y, obj, cfg, None, None, None, None)
+        sharded = _gbdt_fingerprint(
+            x, y, obj, cfg, None, None, None, None,
+            dp_shards=jax.device_count(),
+        )
+        assert base != sharded
+        # the engine KNOB is not identity: pre-PR15 stores keep resuming
+        for engine in ("auto", "fused", "data_parallel"):
+            same = _gbdt_fingerprint(
+                x, y, obj, dataclasses.replace(cfg, engine=engine),
+                None, None, None, None,
+            )
+            assert same == base
+
+    def test_auto_resumes_pre_sharding_fused_store(self, tmp_path,
+                                                   monkeypatch):
+        """A store written by the fused engine (every pre-PR15 store — the
+        old auto default) resumed under engine='auto' that now picks
+        data_parallel must fall back to fused for the whole fit and
+        resume BIT-IDENTICALLY, not refuse under an unchanged config."""
+        from mmlspark_tpu.io.storage_faults import (
+            InjectedCrash,
+            StorageFaultInjector,
+            installed,
+        )
+
+        x, y = _data(1024, seed=31)
+        obj = make_objective("binary", num_class=2)
+        auto_cfg = TrainConfig(**_CFG)  # engine defaults to auto
+        fused_cfg = dataclasses.replace(auto_cfg, engine="fused")
+        base = train_booster(x, y, obj, fused_cfg)
+
+        # a pre-PR15-style store: written by the fused engine, killed
+        # after the first commit
+        kd = str(tmp_path / "legacy")
+        inj = StorageFaultInjector()
+        inj.crash_after_rename(nth=1)
+        with pytest.raises(InjectedCrash):
+            with installed(inj):
+                train_booster(x, y, obj, fused_cfg, checkpoint_dir=kd,
+                              checkpoint_every=2)
+
+        # resume with the UNCHANGED user config (auto), on a mesh where
+        # auto now picks data_parallel at this size
+        monkeypatch.setattr(trainer_mod, "_DP_AUTO_MIN_ROWS", 512)
+        resumed = train_booster(x, y, obj, auto_cfg, checkpoint_dir=kd,
+                                checkpoint_every=2)
+        assert resumed.model_to_string() == base.model_to_string()
+        # an EXPLICIT data_parallel request never silently switches
+        with pytest.raises(ValueError, match="fingerprint"):
+            train_booster(x, y, obj, _dp(auto_cfg), checkpoint_dir=kd,
+                          checkpoint_every=2)
+
+    def test_dp_store_refuses_different_mesh_size(self, tmp_path):
+        """A sharded store resumed under a different shard count is a
+        different accumulation order — fingerprint mismatch, not a silent
+        near-tie flip mid-ensemble."""
+        import jax
+
+        x, y = _data(512, seed=23)
+        obj = make_objective("binary", num_class=2)
+        cfg = _dp(TrainConfig(**{**_CFG, "num_iterations": 2}))
+        ck = str(tmp_path / "ck")
+        train_booster(x, y, obj, cfg, checkpoint_dir=ck, checkpoint_every=1)
+
+        real = jax.device_count
+        try:
+            jax.device_count = lambda *a, **k: 4  # a "different mesh"
+            with pytest.raises(ValueError, match="fingerprint"):
+                train_booster(x, y, obj, cfg, checkpoint_dir=ck,
+                              checkpoint_every=1)
+        finally:
+            jax.device_count = real
+
+
+class TestMeshPadBuckets:
+    def test_shard_batch_pads_to_bucketed_data_axis_multiple(self):
+        import jax
+
+        from mmlspark_tpu.parallel.mesh import (
+            DATA_AXIS,
+            data_parallel_mesh,
+            shard_batch,
+            shard_target_rows,
+        )
+
+        mesh = data_parallel_mesh()
+        nd = mesh.shape[DATA_AXIS]
+        assert jax.device_count() == 8
+        # ragged sizes within one power-of-two bucket land on ONE padded
+        # shape — the compile-capping contract (one program per bucket)
+        shapes = set()
+        for n in (9, 11, 13, 16):
+            arr, real = shard_batch(mesh, np.ones((n, 3), np.float32))
+            assert real == n
+            assert arr.shape[0] == shard_target_rows(n, nd)
+            assert arr.shape[0] % nd == 0
+            shapes.add(arr.shape)
+        assert len(shapes) == 1
+        # bucket edges: 17..32 -> 32
+        arr, _ = shard_batch(mesh, np.ones((17, 3), np.float32))
+        assert arr.shape[0] == 32
+
+    def test_bucketing_rollback_lever_reverts_to_minimal_pad(self):
+        from mmlspark_tpu.core.dispatch import bucketing
+        from mmlspark_tpu.parallel.mesh import (
+            data_parallel_mesh,
+            shard_batch,
+        )
+
+        mesh = data_parallel_mesh()
+        with bucketing(False):
+            arr, real = shard_batch(mesh, np.ones((17, 3), np.float32))
+        # the ONE dispatch rollback lever governs this pad too: disabled,
+        # the pad reverts to the minimal data-axis multiple (24), not the
+        # power-of-two bucket (32)
+        assert real == 17 and arr.shape[0] == 24
+
+    def test_shard_frame_ragged_still_trims_on_device(self):
+        from mmlspark_tpu.parallel.mesh import data_parallel_mesh, shard_frame
+
+        mesh = data_parallel_mesh()
+        df = DataFrame.from_dict({"x": np.arange(21, dtype=np.float32)})
+        out = shard_frame(mesh, df)
+        assert out.column("x").is_device_backed
+        assert out.column("x").shape == (21,)
+        np.testing.assert_array_equal(
+            np.asarray(out["x"]), np.arange(21, dtype=np.float32)
+        )
+
+
+class TestObsWiring:
+    def test_dp_round_metric_carries_shard_label(self):
+        import jax
+
+        x, y = _data(512, seed=29)
+        cfg = _dp(TrainConfig(**{**_CFG, "num_iterations": 2}))
+        obj = make_objective("binary", num_class=2)
+        hist = registry().histogram(
+            "gbdt_round_device_seconds", "", ("engine", "shards")
+        )
+        shards = str(jax.device_count())
+        before = hist.labels(engine="data_parallel", shards=shards).count()
+        train_booster(x, y, obj, cfg)
+        assert hist.labels(
+            engine="data_parallel", shards=shards
+        ).count() == before + 2  # one observation per round
+        assert registry().gauge(
+            "device_mfu", "", ("model",)
+        ).labels(model="gbdt_per_device").value() > 0

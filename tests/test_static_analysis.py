@@ -696,3 +696,39 @@ def test_unstructured_log_exempts_obs_logging_module(tmp_path):
         [str(allowed), str(other)], repo_root=str(tmp_path)
     )
     assert {f.path for f in findings} == {"other.py"}
+
+
+# -- hardcoded device index ----------------------------------------------------
+
+
+def test_device_index_fires_and_suppresses():
+    from mmlspark_tpu.analysis.device_index import check_device_index
+
+    path = os.path.join(FIXTURES, "device_index_bad.py")
+    findings = check_device_index([path], repo_root=FIXTURES)
+    _assert_matches_markers("device_index_bad.py", findings)
+
+
+def test_device_index_honors_guards_and_slices():
+    """Single-device-guarded branches and prefix slices (device-SET
+    selection for mesh construction) must stay silent."""
+    from mmlspark_tpu.analysis.device_index import check_device_index
+
+    path = os.path.join(FIXTURES, "device_index_bad.py")
+    findings = check_device_index([path], repo_root=FIXTURES)
+    with open(path) as f:
+        src = f.read().splitlines()
+    guarded = {
+        i for i, line in enumerate(src, start=1)
+        if "jax.devices()[0]" in line and "expect" not in line
+    }
+    assert guarded, "fixture lost its guarded/clean uses"
+    assert not {f.line for f in findings} & guarded
+
+
+def test_device_index_package_scan_clean_via_runner():
+    """The live package passes the rule through run_all — the trainer's
+    shard->device ownership and env.py's kind probe stay index-free (the
+    PR 15 mesh-sharding contract)."""
+    findings = run_all(root=REPO, select=["hardcoded-device-index"])
+    assert not findings, "\n".join(str(f) for f in findings)

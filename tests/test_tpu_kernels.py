@@ -106,3 +106,41 @@ def test_device_walk_against_host_reference_at_scale():
     dev = b._walk_device(xt, packed)
     ref = b._walk_numpy(xt[:512], packed)
     np.testing.assert_allclose(dev[:512], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_streamed_fit_routes_pallas_and_matches_einsum():
+    """ISSUE 15 satellite: streamed (out-of-core) fits on a single TPU chip
+    route their per-chunk histogram passes through the fused Pallas
+    route+hist kernel (ragged chunks padded to the kernel block with
+    masked-out rows — exact, zero-weight rows add 0.0f) and must grow
+    IDENTICAL trees to the einsum chunk path."""
+    import jax
+
+    from mmlspark_tpu.gbdt.objectives import make_objective
+    from mmlspark_tpu.gbdt.trainer import TrainConfig, train_booster
+
+    if jax.device_count() > 1:
+        pytest.skip(
+            "multi-device hosts shard the chunk stream and take the einsum "
+            "path on both sides — the pallas comparison needs one device"
+        )
+
+    rng = np.random.default_rng(3)
+    n, f = 20_000, 8
+    x = rng.normal(size=(n, f))
+    y = ((x[:, 0] + 0.4 * x[:, 1]) > 0).astype(np.float64)
+    cfg = TrainConfig(num_iterations=4, num_leaves=9, max_bin=63,
+                      verbosity=0)
+    obj = make_objective("binary", num_class=2)
+    # chunk size deliberately NOT a hist-block multiple: exercises the pad
+    bp = train_booster(x, y, obj, cfg, stream_chunk_rows=3000)
+    orig = jax.default_backend
+    jax.default_backend = lambda: "cpu"  # force the einsum chunk branch
+    try:
+        be = train_booster(x, y, obj, cfg, stream_chunk_rows=3000)
+    finally:
+        jax.default_backend = orig
+    assert len(bp.trees) == len(be.trees)
+    for a, b in zip(bp.trees, be.trees):
+        assert a.split_feature == b.split_feature
+        np.testing.assert_allclose(a.leaf_value, b.leaf_value, rtol=1e-6)
