@@ -266,6 +266,29 @@ def _gate_pr14(r):
     )
 
 
+def _gate_pr16(r):
+    m = r["memory"]
+    c, rec, leak = m["cycle"], m["reconcile"], m["leak"]
+    skew, ov = m["skew"], m["overhead"]
+    return (
+        c["returned_to_baseline"]
+        and c["model_weights_bytes"] > 0
+        and c["dispatch_programs_bytes"] > 0
+        and c["prefetch_chunks_mid_bytes"] > 0
+        and c["prefetch_chunks_end_bytes"] == 0
+        and rec["drifted"] == []
+        and rec["devices_checked"] > 0
+        and leak["detected"]
+        and leak["class"] == "scratch"
+        and skew["balanced_ratio"] is not None
+        and skew["balanced_ratio"] <= 2.0
+        and skew["straggler"]["ratio"] is not None
+        and skew["straggler"]["ratio"] >= skew["factor"]
+        and skew["straggler"]["warnings_fired"] >= 1
+        and ov["overhead_frac"] <= 0.05
+    )
+
+
 #: artifact basename -> that bench's own tier-1 gate (the clobber guard)
 _BENCH_GATES = {
     "BENCH_pr03.json": _gate_pr03,
@@ -278,6 +301,7 @@ _BENCH_GATES = {
     "BENCH_pr13.json": _gate_pr13,
     "BENCH_pr14.json": _gate_pr14,
     "BENCH_pr15.json": _gate_pr15,
+    "BENCH_pr16.json": _gate_pr16,
 }
 
 def peak_flops() -> float:
@@ -2931,6 +2955,250 @@ def run_sharded_gbdt_smoke(out_path: str = "BENCH_pr15.json") -> dict:
     return _write_report(report, out_path)
 
 
+def run_memory_smoke(out_path: str = "BENCH_pr16.json") -> dict:
+    """Device-memory ledger + shard-skew smoke bench (CPU-safe; wired into
+    tier-1 via tests/test_bench_smoke.py::test_memory_smoke_gates). ISSUE
+    16 acceptance on the 8-virtual-device mesh:
+
+    - cycle: a featurize->score TPUModel pass uploads weights and retains
+      AOT programs, a chunk prefetcher stages payloads — every class shows
+      up in the ledger, and evicting (dispatch-cache clear + bundle GC +
+      prefetch drain) returns the ledger EXACTLY to its baseline.
+    - reconcile: a mid-cycle truth-check against jax.live_arrays() stays
+      within tolerance on every device (no phantom drift).
+    - leak: a synthetic scratch leak on a tightly-knobbed private ledger
+      IS detected — one structured warning naming the offending class.
+    - skew: a balanced data-parallel GBDT fit reports shard skew near 1.0;
+      a fault-injected 30 ms delay on one shard (_SHARD_DELAY_FN, the
+      exact code path a straggling chip would take) trips the persistent
+      straggler warning with skew above the configured factor.
+    - overhead: the ledger + skew instrumentation costs <= 5% on a
+      prefetch-consume + dp-fit workload vs `obs.disabled()` (alternating
+      best-of-2 arms, run_obs_overhead_smoke discipline).
+    """
+    import dataclasses
+    import gc
+
+    import jax
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.core.dispatch import dispatch_cache
+    from mmlspark_tpu.core.prefetch import DeviceChunkPrefetcher
+    from mmlspark_tpu.dnn import mlp
+    from mmlspark_tpu.dnn.network import NetworkBundle
+    from mmlspark_tpu.gbdt import trainer as trainer_mod
+    from mmlspark_tpu.gbdt.objectives import make_objective
+    from mmlspark_tpu.gbdt.trainer import TrainConfig, train_booster
+    from mmlspark_tpu.models import TPUModel
+    from mmlspark_tpu.obs.memory import DeviceMemoryLedger, memory_ledger
+    from mmlspark_tpu.obs.metrics import parse_prometheus, registry
+
+    nd = jax.device_count()
+    if nd < 8:
+        # unwritten skip: a mis-launched single-device run must not
+        # clobber the committed 8-way artifact (run_sharded_gbdt_smoke
+        # discipline)
+        return {"skipped": True, "n_devices": nd,
+                "reason": "needs XLA_FLAGS=--xla_force_host_platform_"
+                          "device_count=8 (set before jax import)"}
+
+    led = memory_ledger()
+    rng = np.random.default_rng(16)
+
+    def metric_value(name, **labels):
+        samples = parse_prometheus(registry().render_prometheus())
+        want = {(k, str(v)) for k, v in labels.items()}
+        for (n, lbls), v in samples.items():
+            if n == name and want <= set(lbls):
+                return v
+        return None
+
+    def cls_total(snap, cls):
+        return sum(by.get(cls, 0) for by in snap.values())
+
+    # -- featurize -> score -> evict cycle ------------------------------------
+    # settle the process first: programs and dead uploads from earlier
+    # smoke sections must not decrement the ledger mid-cycle
+    dispatch_cache().clear()
+    gc.collect()
+    baseline_total = led.total_bytes()
+    baseline_snap = led.snapshot()
+
+    net = mlp(8, [17], 4)
+    bundle = NetworkBundle(net, net.init(jax.random.PRNGKey(0)))
+    model = TPUModel(bundle, input_col="features", output_col="scores",
+                     mini_batch_size=32)
+    df = DataFrame.from_dict(
+        {"features": rng.normal(size=(48, 8)).astype(np.float32)}
+    )
+    out = model.transform(df)
+    np.asarray(out["scores"])  # the one exit fetch
+
+    resident = led.snapshot()
+    weights_b = cls_total(resident, "model_weights") - cls_total(
+        baseline_snap, "model_weights")
+    programs_b = cls_total(resident, "dispatch_programs") - cls_total(
+        baseline_snap, "dispatch_programs")
+
+    # prefetch_chunks: resident while staged, drained to zero at exhaustion
+    payload = {"bins": np.zeros((512, 16), np.uint8),
+               "g": np.zeros(512, np.float32)}
+    pf = DeviceChunkPrefetcher(iter(range(6)), lambda i: dict(payload),
+                               depth=2)
+    it = iter(pf)
+    next(it)
+    # the first pop frees its chunk immediately; wait for the producer to
+    # stage the next window so the class is observably resident
+    prefetch_mid = 0
+    deadline = time.perf_counter() + 10.0
+    while prefetch_mid <= 0 and time.perf_counter() < deadline:
+        prefetch_mid = cls_total(
+            led.snapshot(), "prefetch_chunks"
+        ) - cls_total(baseline_snap, "prefetch_chunks")
+        if prefetch_mid <= 0:
+            time.sleep(0.005)
+    for _ in it:
+        pass
+    prefetch_end = cls_total(led.snapshot(), "prefetch_chunks") - cls_total(
+        baseline_snap, "prefetch_chunks")
+
+    # truth-check while weights + programs are resident
+    rec = led.reconcile()
+    reconcile_report = {
+        "drifted": rec["drifted"],
+        "devices_checked": len(rec["devices"]),
+        "max_phantom_bytes": max(
+            (d["phantom_bytes"] for d in rec["devices"].values()),
+            default=0.0,
+        ),
+    }
+
+    # evict: AOT programs decrement on cache clear, weights on bundle GC
+    dispatch_cache().clear()
+    del out, model, bundle, df
+    gc.collect()
+    end_total = led.total_bytes()
+
+    cycle = {
+        "baseline_bytes": baseline_total,
+        "model_weights_bytes": weights_b,
+        "dispatch_programs_bytes": programs_b,
+        "prefetch_chunks_mid_bytes": prefetch_mid,
+        "prefetch_chunks_end_bytes": prefetch_end,
+        "end_bytes": end_total,
+        "returned_to_baseline": end_total == baseline_total,
+    }
+
+    # -- synthetic leak -------------------------------------------------------
+    # private ledger with tight knobs so the detector's thresholds are the
+    # bench's, not the deployment defaults; monotonic scratch allocs with
+    # no frees are exactly the pattern the trend detector exists for
+    leak_led = DeviceMemoryLedger(
+        leak_min_samples=8, leak_growth_frac=0.2, leak_min_growth_bytes=4096
+    )
+    for _ in range(12):
+        leak_led.record_alloc("cpu:0", "scratch", 8192, owner="bench:leak")
+    events = leak_led.leak_events()
+    leak_report = {
+        "detected": bool(events),
+        "class": events[0]["class"] if events else None,
+        "growth_bytes": events[0]["growth_bytes"] if events else 0,
+        "warnings": len(events),
+    }
+    leak_led.clear()
+
+    # -- shard skew + fault-injected straggler --------------------------------
+    n, F = 16_384, 16
+    x = rng.normal(size=(n, F))
+    y = (x[:, 0] + 0.5 * x[:, 1]
+         + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    dp_cfg = TrainConfig(num_iterations=4, num_leaves=7, max_bin=31,
+                         verbosity=0, engine="data_parallel")
+    obj = make_objective("binary", num_class=2)
+
+    train_booster(x, y, obj, dp_cfg)  # warm: compiles
+    train_booster(x, y, obj, dp_cfg)  # balanced arm on warm programs
+    balanced_ratio = metric_value(
+        "gbdt_shard_skew_ratio", engine="data_parallel")
+    warns_before = metric_value(
+        "gbdt_straggler_warnings_total", engine="data_parallel") or 0.0
+
+    trainer_mod._SHARD_DELAY_FN = lambda i: 0.03 if i == 3 else 0.0
+    try:
+        train_booster(x, y, obj, dp_cfg)
+    finally:
+        trainer_mod._SHARD_DELAY_FN = None
+    straggler_ratio = metric_value(
+        "gbdt_shard_skew_ratio", engine="data_parallel")
+    warns_after = metric_value(
+        "gbdt_straggler_warnings_total", engine="data_parallel") or 0.0
+
+    skew_report = {
+        "n_shards": nd,
+        "balanced_ratio": (
+            round(balanced_ratio, 4) if balanced_ratio is not None else None
+        ),
+        "factor": 3.0,
+        "straggler": {
+            "injected_delay_ms": 30.0,
+            "ratio": (
+                round(straggler_ratio, 4)
+                if straggler_ratio is not None else None
+            ),
+            "warnings_fired": int(warns_after - warns_before),
+        },
+    }
+
+    # -- instrumentation overhead ---------------------------------------------
+    # the ledger-heavy workload: a counted chunk-prefetch consume loop plus
+    # one dp mini-fit (skew meter + per-shard ledger) per arm
+    ov_payload = {"bins": np.zeros((4096, 32), np.uint8),
+                  "g": np.zeros(4096, np.float32)}
+    ov_cfg = dataclasses.replace(dp_cfg, num_iterations=2)
+
+    def arm():
+        t0 = time.perf_counter()
+        pf = DeviceChunkPrefetcher(
+            iter(range(24)), lambda i: dict(ov_payload), depth=3)
+        for _ in pf:
+            time.sleep(1e-3)  # bounded per-chunk consumer cost
+        train_booster(x, y, obj, ov_cfg)
+        return time.perf_counter() - t0
+
+    train_booster(x, y, obj, ov_cfg)  # warm the 2-iteration programs
+    # alternate arms, best-of-2 each: a fixed order would bill warm-up to
+    # whichever arm ran first (run_obs_overhead_smoke's measured ~25%
+    # phantom overhead on a cold process)
+    walls = []
+    for instrumented in (True, False, True, False):
+        ctx = contextlib.nullcontext() if instrumented else obs.disabled()
+        with ctx:
+            walls.append(arm())
+    instrumented_s = min(walls[0], walls[2])
+    disabled_s = min(walls[1], walls[3])
+    overhead = {
+        "instrumented_s": round(instrumented_s, 4),
+        "disabled_s": round(disabled_s, 4),
+        "overhead_frac": round(
+            max(0.0, instrumented_s / disabled_s - 1.0), 4),
+    }
+
+    report = {
+        "pr": 16,
+        "platform": jax.default_backend(),
+        "n_devices": nd,
+        "memory": {
+            "cycle": cycle,
+            "reconcile": reconcile_report,
+            "leak": leak_report,
+            "skew": skew_report,
+            "overhead": overhead,
+        },
+    }
+    return _write_report(report, out_path)
+
+
 def main() -> int:
     from mmlspark_tpu.dnn import resnet20_cifar
 
@@ -3009,5 +3277,6 @@ if __name__ == "__main__":
         print(json.dumps(run_profiler_smoke(), sort_keys=True))
         print(json.dumps(run_slo_trace_smoke(), sort_keys=True))
         print(json.dumps(run_sharded_gbdt_smoke(), sort_keys=True))
+        print(json.dumps(run_memory_smoke(), sort_keys=True))
         sys.exit(0)
     sys.exit(main())

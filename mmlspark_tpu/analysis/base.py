@@ -44,6 +44,8 @@ RULES: Dict[str, str] = {
     "unstructured-log-in-library": "logging.getLogger/bare print()/legacy core.config.get_logger in library code; log through obs.logging.get_logger (structured JSON lines with trace correlation)",
     # device-index family (device_index.py)
     "hardcoded-device-index": "scalar index into jax.devices()/jax.local_devices() pins work to one device outside a single-device-guarded branch; place through the mesh or a shard->device ownership map",
+    # untracked-upload family (untracked_upload.py)
+    "untracked-device-upload": "jax.device_put/jnp.asarray(device=) upload in a dataplane module whose scope shows no counting evidence (upload_host_chunk/record_h2d/memory_ledger); invisible H2D bytes are what make /debug/memory reconciliation drift",
     # Params-contract family (params_contract.py)
     "param-converter": "simple Param declared without an explicit type converter",
     "param-doc": "stage or Param missing documentation",

@@ -179,6 +179,32 @@ def run_all(
         # scaling bug wherever it hides (ISSUE 15 — the GBDT trainer
         # stayed single-chip exactly this way)
         findings += check_device_index(package_files, repo_root=root)
+    if "untracked-device-upload" in enabled:
+        from mmlspark_tpu.analysis.untracked_upload import (
+            check_untracked_upload,
+        )
+
+        # scoped to the dataplane tier: the modules whose uploads the
+        # device-memory ledger and H2D counters claim to account for
+        # (ISSUE 16) — an uncounted device_put here is exactly the byte
+        # stream /debug/memory reconciliation reports as unattributed
+        upload_files = {
+            os.path.join(package_name, "core", "dataframe.py"),
+            os.path.join(package_name, "core", "prefetch.py"),
+            os.path.join(package_name, "parallel", "mesh.py"),
+            os.path.join(package_name, "models", "tpu_model.py"),
+            os.path.join(package_name, "dnn", "network.py"),
+            os.path.join(package_name, "gbdt", "booster.py"),
+            os.path.join(package_name, "gbdt", "trainer.py"),
+            os.path.join(package_name, "images", "device_ops.py"),
+        }
+        findings += check_untracked_upload(
+            [
+                p for p in package_files
+                if os.path.relpath(p, root) in upload_files
+            ],
+            repo_root=root,
+        )
     if "unstructured-log-in-library" in enabled:
         from mmlspark_tpu.analysis.unstructured_log import (
             check_unstructured_log,

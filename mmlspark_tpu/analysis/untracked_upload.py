@@ -1,0 +1,188 @@
+"""Untracked-device-upload rule: dataplane uploads must be counted.
+
+`untracked-device-upload` flags host->device uploads — ``jax.device_put``
+(and the ``device_put_sharded`` / ``device_put_replicated`` variants), or
+``jnp.asarray`` / ``jnp.array`` carrying an explicit ``device=`` keyword —
+inside the dataplane-tier modules when the surrounding scope shows no
+counting evidence. Bytes that cross the PCIe/ICI tunnel invisibly are
+exactly how the device-memory ledger (obs/memory.py) and the H2D transfer
+counters drift from reality: `/debug/memory`'s reconciliation then reports
+unattributed live bytes that nobody can trace back to a call site.
+
+A scope (each function body, or the module top level) counts as COUNTED
+when it calls any of the sanctioned accounting helpers, anywhere in the
+scope:
+
+- ``upload_host_chunk`` (core/prefetch.py) — the counted leaf-wise upload;
+- ``record_h2d`` — the dataplane transfer counters;
+- ``memory_ledger`` / ``record_alloc`` / ``record_alloc_devices`` — the
+  device-memory ledger.
+
+Scope-level evidence (rather than per-call data flow) is deliberate: the
+serving forward loop counts ONCE per branch and uploads on the next line,
+and a finer rule would force contortions for zero extra safety. The rule
+is scoped by the runner to the dataplane tier (core/dataframe.py,
+core/prefetch.py, parallel/mesh.py, models/tpu_model.py, dnn/network.py,
+gbdt/booster.py, gbdt/trainer.py, images/device_ops.py) — a test helper's
+one-off device_put is not a dataplane leak.
+
+NOT flagged:
+
+- ``jnp.asarray`` / ``jnp.array`` WITHOUT ``device=`` — plain dtype/layout
+  coercion that stays wherever its input lives;
+- aliasing without calling (``shard = jax.device_put``) — the alias's call
+  sites are judged in their own scope;
+- scopes with counting evidence, per the list above.
+
+Bounded scratch uploads whose residency is deliberately not ledgered
+(e.g. the fused GBDT engine's per-iteration bagging masks) take
+``# graftcheck: ignore[untracked-device-upload]`` with a comment saying
+why the bytes are out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Set
+
+from mmlspark_tpu.analysis.base import Finding
+
+_RULE = "untracked-device-upload"
+
+_UPLOAD_FNS = {"device_put", "device_put_sharded", "device_put_replicated"}
+_ARRAY_FNS = {"asarray", "array"}
+_EVIDENCE_NAMES = {
+    "upload_host_chunk",
+    "record_h2d",
+    "record_alloc",
+    "record_alloc_devices",
+    "memory_ledger",
+}
+
+
+def _jax_aliases(tree: ast.AST) -> Set[str]:
+    """Module aliases of jax: `import jax` / `import jax as j`."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax":
+                    out.add(alias.asname or "jax")
+    return out
+
+
+def _jnp_aliases(tree: ast.AST) -> Set[str]:
+    """`import jax.numpy as jnp` / `from jax import numpy as jnp`."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax.numpy" and alias.asname:
+                    out.add(alias.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "numpy":
+                        out.add(alias.asname or "numpy")
+    return out
+
+
+def _is_upload_call(node: ast.AST, jax_names: Set[str],
+                    jnp_names: Set[str]) -> bool:
+    """A call that moves host bytes onto a device."""
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if (
+        isinstance(func, ast.Attribute)
+        and func.attr in _UPLOAD_FNS
+        and isinstance(func.value, ast.Name)
+        and func.value.id in jax_names
+    ):
+        return True
+    return (
+        isinstance(func, ast.Attribute)
+        and func.attr in _ARRAY_FNS
+        and isinstance(func.value, ast.Name)
+        and func.value.id in jnp_names
+        and any(kw.arg == "device" for kw in node.keywords)
+    )
+
+
+def _is_evidence_call(node: ast.AST) -> bool:
+    """A call to any sanctioned accounting helper, by name or attribute
+    (``upload_host_chunk(...)``, ``counters.record_h2d(...)``,
+    ``led.record_alloc(...)``, ``memory_ledger()``)."""
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id in _EVIDENCE_NAMES
+    return isinstance(func, ast.Attribute) and func.attr in _EVIDENCE_NAMES
+
+
+def _walk_scope(scope: ast.AST) -> Iterable[ast.AST]:
+    """Document-order walk without descending into nested function/class
+    bodies — each nested scope is judged on its own evidence (the
+    device-index rule's traversal contract)."""
+    body = scope.body if hasattr(scope, "body") else []
+    stack = list(reversed(body))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+
+def _scan_scope(scope: ast.AST, rel: str, jax_names: Set[str],
+                jnp_names: Set[str], findings: List[Finding]) -> None:
+    uploads: List[ast.AST] = []
+    counted = False
+    for node in _walk_scope(scope):
+        if _is_evidence_call(node):
+            counted = True
+        if _is_upload_call(node, jax_names, jnp_names):
+            uploads.append(node)
+    if counted:
+        return
+    flagged: Set[int] = set()
+    for node in uploads:
+        if node.lineno in flagged:
+            continue
+        flagged.add(node.lineno)
+        findings.append(Finding(
+            _RULE, rel, node.lineno,
+            "device upload in a dataplane module with no counting "
+            "evidence in scope; route it through "
+            "core/prefetch.upload_host_chunk or pair it with "
+            "record_h2d + a memory_ledger record_alloc so the bytes "
+            "stay attributable",
+        ))
+
+
+def check_untracked_upload(
+    paths: Iterable[str], repo_root: Optional[str] = None
+) -> List[Finding]:
+    repo_root = repo_root or os.getcwd()
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        jax_names = _jax_aliases(tree)
+        jnp_names = _jnp_aliases(tree)
+        if not jax_names and not jnp_names:
+            continue  # module never imports jax: nothing uploads
+        rel = os.path.relpath(path, repo_root)
+        _scan_scope(tree, rel, jax_names, jnp_names, findings)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_scope(node, rel, jax_names, jnp_names, findings)
+    return findings

@@ -138,6 +138,37 @@ def _extract_cost(compiled) -> Optional[Dict[str, float]]:
     return out or None
 
 
+def _executable_nbytes(compiled) -> int:
+    """Resident-footprint estimate for one AOT executable: the compiler's
+    own generated-code size when the backend reports it (memory_analysis),
+    else the HLO text length as a coarse serialized-size proxy, else 0
+    (untracked). Whatever this returns at insert is EXACTLY what eviction
+    hands back, so the ledger balances even when the estimate is rough."""
+    try:
+        ma = compiled.memory_analysis()
+        size = getattr(ma, "generated_code_size_in_bytes", None)
+        if size:
+            return int(size)
+    except Exception:  # backend-specific probe; fall to the next estimate  # graftcheck: ignore[broad-except]
+        pass
+    try:
+        return len(compiled.as_text())
+    except Exception:  # best-effort size probe; 0 = untracked, not an error  # graftcheck: ignore[broad-except]
+        return 0
+
+
+def _program_device() -> str:
+    """Executables live on the attached backend; attribute them to the
+    default device (per-device program residency would need per-device
+    caches, which nothing has)."""
+    from mmlspark_tpu.obs.memory import default_device_label
+
+    try:
+        return default_device_label()
+    except Exception:  # no backend attached: attribution, not correctness  # graftcheck: ignore[broad-except]
+        return "unknown"
+
+
 def bucket_rows(n: int, cap: Optional[int] = None) -> int:
     """Smallest power of two >= n, capped at `cap` (cap need not be a power
     of two — it wins, keeping mini_batch_size semantics exact)."""
@@ -264,6 +295,9 @@ class DispatchCache:
         # wrapper instead — retrying every dispatch would re-pay the failure)
         self._aot: "OrderedDict[Tuple[Any, Any], Any]" = OrderedDict()
         self._aot_inflight: Dict[Tuple[Any, Any], threading.Event] = {}
+        # entry -> (nbytes, owner tag) as recorded in the device-memory
+        # ledger at insert; eviction/clear free exactly these
+        self._aot_sizes: Dict[Tuple[Any, Any], Tuple[int, str]] = {}
         # process-wide eviction tally (an unlabeled counter: every instance
         # adds to the same series, which is the total the metric means)
         self._evictions = registry().counter(
@@ -338,12 +372,33 @@ class DispatchCache:
             # always release waiters — a BaseException here must not park
             # other dispatch threads forever (an interrupted compile caches
             # None, the same plain-jit fallback as a failed one)
+            nbytes = _executable_nbytes(compiled) if compiled is not None else 0
+            owner = f"aot:{site}"
+            freed = []
             with self._lock:
                 while len(self._aot) >= self._max_programs:
-                    self._aot.popitem(last=False)
+                    old_entry, _ = self._aot.popitem(last=False)
                     self._evictions.inc()
+                    old_size = self._aot_sizes.pop(old_entry, None)
+                    if old_size is not None:
+                        freed.append(old_size)
                 self._aot[entry] = compiled
+                if nbytes > 0:
+                    self._aot_sizes[entry] = (nbytes, owner)
                 self._aot_inflight.pop(entry).set()
+            from mmlspark_tpu.obs.memory import memory_ledger
+
+            led = memory_ledger()
+            if nbytes > 0 or freed:
+                dev = _program_device()
+                if nbytes > 0:
+                    led.record_alloc(dev, "dispatch_programs", nbytes,
+                                     owner=owner)
+                # evictions RECLAIM: the executable's bytes leave the ledger
+                # with it, instead of lingering as phantom residency
+                for old_bytes, old_owner in freed:
+                    led.record_free(dev, "dispatch_programs", old_bytes,
+                                    owner=old_owner)
         return compiled
 
     def note_dispatch(self, key: Any, shape: Tuple[int, ...]) -> bool:
@@ -371,6 +426,15 @@ class DispatchCache:
             self._fns.clear()
             self._shapes.clear()
             self._aot.clear()
+            freed = list(self._aot_sizes.values())
+            self._aot_sizes.clear()
+        if freed:
+            from mmlspark_tpu.obs.memory import memory_ledger
+
+            led = memory_ledger()
+            dev = _program_device()
+            for nbytes, owner in freed:
+                led.record_free(dev, "dispatch_programs", nbytes, owner=owner)
 
 
 def _aot_log():

@@ -52,21 +52,61 @@ def peak_flops_per_sec() -> float:
     """Best-effort peak FLOP/s for the attached backend: the bf16 table for
     known TPU kinds, the documented nominal for CPU, 0.0 when unknown
     (callers omit MFU rather than report a wrong one)."""
+    if default_backend() == "cpu":
+        return CPU_NOMINAL_PEAK_FLOPS
+    return _per_kind_lookup(PEAK_FLOPS_BY_KIND)
+
+
+# -- HBM capacity table (the memory-pressure denominator) ----------------------
+#
+# HBM bytes per chip by TPU device kind, from the public spec sheets — the
+# capacity the device-memory ledger (obs/memory.py) divides resident bytes
+# by for its `device_memory_pressure` gauge, and the budget every future
+# HBM byte-budget manager enforces against. Same single-source discipline
+# as PEAK_FLOPS_BY_KIND: bench artifacts and /metrics agree by construction.
+HBM_BYTES_BY_KIND = {
+    "v5 lite": 16e9,
+    "v5e": 16e9,
+    "v4": 32e9,
+    "v5p": 95e9,
+    "v5": 95e9,
+    "v6 lite": 32e9,
+    "v6e": 32e9,
+    "v3": 16e9,
+    "v2": 8e9,
+}
+
+# Nominal per-virtual-device capacity for the CPU backend. Like
+# CPU_NOMINAL_PEAK_FLOPS this anchors *relative* movement (a pressure gauge
+# doubling means residency doubled) and exercises the pressure plumbing in
+# CI — it is not a host-RAM claim. 4 GB keeps smoke-scale residency well
+# under 1.0 while leaving leak-injection headroom visible.
+CPU_NOMINAL_HBM_BYTES = 4e9
+
+
+def hbm_bytes_per_device() -> float:
+    """Best-effort HBM bytes per attached device: the spec-sheet table for
+    known TPU kinds, the documented nominal for CPU, 0.0 when unknown
+    (callers omit the pressure gauge rather than report a wrong one)."""
+    if default_backend() == "cpu":
+        return CPU_NOMINAL_HBM_BYTES
+    return _per_kind_lookup(HBM_BYTES_BY_KIND)
+
+
+def _per_kind_lookup(table: dict) -> float:
+    """Per-chip constants are a DEVICE-KIND property, not a device-0
+    property: probe every local device and require agreement, so a
+    (hypothetical) mixed-kind mesh reports 0.0 (unknown) instead of
+    silently assuming the whole pod matches device 0."""
     import jax
 
-    if jax.default_backend() == "cpu":
-        return CPU_NOMINAL_PEAK_FLOPS
-    # per-chip peak is a DEVICE-KIND property, not a device-0 property:
-    # probe every local device and require agreement, so a (hypothetical)
-    # mixed-kind mesh reports 0.0 (unknown) instead of silently assuming
-    # the whole pod runs at device 0's peak
     kinds = {d.device_kind.lower() for d in jax.local_devices()}
     if len(kinds) != 1:
         return 0.0
     kind = kinds.pop()
-    for key, peak in PEAK_FLOPS_BY_KIND.items():
+    for key, value in table.items():
         if key in kind:
-            return peak
+            return value
     return 0.0
 
 

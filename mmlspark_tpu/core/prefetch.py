@@ -56,6 +56,21 @@ _SENTINEL = object()
 
 _METRICS: Dict[str, Any] = {}
 
+# all live pipeline states (weak: an abandoned prefetcher must stay
+# collectable) plus the peak of the most recently finished loop — the
+# resident-peak gauge aggregates over BOTH at scrape time, so two
+# concurrently live prefetchers (streamed GBDT + an image pipeline) can
+# no longer clobber each other's high-water mark
+_LIVE_STATES: "weakref.WeakSet" = weakref.WeakSet()
+_STATES_LOCK = threading.Lock()
+_LAST_FINISHED_PEAK = 0.0
+
+
+def _resident_peak_now() -> float:
+    with _STATES_LOCK:
+        peaks = [s.resident_peak for s in _LIVE_STATES]
+    return float(max([_LAST_FINISHED_PEAK] + peaks))
+
 
 def _metrics() -> Dict[str, Any]:
     """Process-wide prefetch instruments, created on first use (keeps this
@@ -75,11 +90,13 @@ def _metrics() -> Dict[str, Any]:
             "dataplane_prefetch_overlap_ratio",
             "1 - consumer wait / producer prep for the most recently "
             "finished prefetch loop (1.0 = prep fully hidden)")
-        _METRICS["resident_peak"] = reg.gauge(
+        peak = reg.gauge(
             "dataplane_prefetch_resident_bytes_peak",
-            "High-water mark of device bytes parked in the prefetch queue "
-            "for the most recently finished prefetch loop (the depth-bounded "
-            "HBM footprint of streaming ingestion)")
+            "High-water mark of device bytes parked in prefetch queues: the "
+            "max over all LIVE prefetchers and the most recently finished "
+            "loop (the depth-bounded HBM footprint of streaming ingestion)")
+        peak.set_function(_resident_peak_now)
+        _METRICS["resident_peak"] = peak
     return _METRICS
 
 
@@ -129,6 +146,74 @@ class _PrefetchState:
         self.tl_lock = threading.Lock()
         self.resident_bytes = 0
         self.resident_peak = 0
+        # index -> (device label, nbytes) for chunks the device-memory
+        # ledger currently counts as resident (uploaded, not yet consumed);
+        # once `ledger_released` the pipeline stops adding and any
+        # still-producing upload is freed immediately
+        self.ledger_entries: Dict[int, Any] = {}
+        self.ledger_released = False
+        self.owner = f"prefetch-{id(self)}"
+
+
+def _ledger_add(state: _PrefetchState, idx: int, batch: Any,
+                nbytes: int) -> None:
+    """Attribute one uploaded chunk to its owning device in the
+    device-memory ledger (prefetch_chunks class). In the PR 15 placement
+    mode each chunk lands on its owner device, so the label comes from the
+    uploaded leaves, not the pipeline default."""
+    from mmlspark_tpu.obs.memory import device_label, memory_ledger
+
+    led = memory_ledger()
+    if not led.enabled:
+        return
+    leaf = batch
+    if isinstance(leaf, dict):
+        leaf = next(iter(leaf.values()), None)
+    elif isinstance(leaf, (tuple, list)):
+        leaf = leaf[0] if leaf else None
+    dev = device_label(leaf)
+    led.record_alloc(dev, "prefetch_chunks", nbytes, owner=state.owner)
+    with state.tl_lock:
+        if not state.ledger_released:
+            state.ledger_entries[idx] = (dev, nbytes)
+            return
+    led.record_free(dev, "prefetch_chunks", nbytes, owner=state.owner)
+
+
+def _ledger_pop(state: _PrefetchState, idx: int) -> None:
+    """The consumer took chunk `idx` off the queue: its bytes are now the
+    consumer's to account, not the prefetcher's."""
+    with state.tl_lock:
+        entry = state.ledger_entries.pop(idx, None)
+    if entry is None:
+        return
+    from mmlspark_tpu.obs.memory import memory_ledger
+
+    memory_ledger().record_free(
+        entry[0], "prefetch_chunks", entry[1], owner=state.owner)
+
+
+def _ledger_release(state: _PrefetchState) -> None:
+    """Free every still-parked chunk (end of loop, close(), or the GC
+    finalizer) and refuse future adds — idempotent."""
+    with state.tl_lock:
+        if state.ledger_released and not state.ledger_entries:
+            return
+        state.ledger_released = True
+        entries = list(state.ledger_entries.values())
+        state.ledger_entries.clear()
+    if not entries:
+        return
+    from mmlspark_tpu.obs.memory import memory_ledger
+
+    led = memory_ledger()
+    for dev, nbytes in entries:
+        led.record_free(dev, "prefetch_chunks", nbytes, owner=state.owner)
+
+
+def _finalize_state(state: _PrefetchState) -> None:
+    state.stop.set()
+    _ledger_release(state)
 
 
 def _produce(
@@ -202,6 +287,8 @@ def _produce(
                     state.resident_peak = max(
                         state.resident_peak, state.resident_bytes
                     )
+                if upload:
+                    _ledger_add(state, idx, batch, nbytes)
                 while not state.stop.is_set():
                     try:
                         state.q.put((idx, batch, entry), timeout=0.05)
@@ -254,10 +341,14 @@ class _ChunkPipeline:
     ):
         self._state = _PrefetchState(max(1, int(depth)))
         self._started = False
+        with _STATES_LOCK:
+            _LIVE_STATES.add(self._state)
         # the thread closes over state/source/stage_fn only — NOT self —
         # so an abandoned prefetcher is collectable, and this finalizer
-        # then stops the producer (it also runs at interpreter shutdown)
-        self._finalizer = weakref.finalize(self, self._state.stop.set)
+        # then stops the producer and releases its ledger bytes (it also
+        # runs at interpreter shutdown)
+        self._finalizer = weakref.finalize(
+            self, _finalize_state, self._state)
         self._thread = threading.Thread(
             target=_produce,
             args=(self._state, source, stage_fn,
@@ -300,6 +391,7 @@ class _ChunkPipeline:
             entry["requested_t"] = t_req
             entry["wait_s"] = now - t_req
             state.resident_bytes -= int(entry["nbytes"])
+        _ledger_pop(state, idx)
         m = _metrics()
         m["batches"].inc()
         if idx > 0 and entry["upload_done_t"] <= t_req:
@@ -317,12 +409,16 @@ class _ChunkPipeline:
         self._state.stop.set()
         if self._started:
             self._thread.join(timeout=5.0)
+        _ledger_release(self._state)
 
     def _finish(self) -> None:
+        global _LAST_FINISHED_PEAK
         s = self.summary()
         m = _metrics()
         m["ratio"].set(s["overlap_ratio"])
-        m["resident_peak"].set(s["resident_bytes_peak"])
+        with _STATES_LOCK:
+            _LAST_FINISHED_PEAK = float(s["resident_bytes_peak"])
+        _ledger_release(self._state)
 
     # -- evidence ----------------------------------------------------------
 

@@ -641,10 +641,14 @@ class NetworkBundle:
         """Weights as device-resident arrays, uploaded once per bundle — a
         ResNet-50's ~100MB of params re-crossing the host->HBM link on every
         transform call would dominate small-batch inference. The one upload
-        is counted in profiling.dataplane_counters()."""
+        is counted in profiling.dataplane_counters() and held in the
+        device-memory ledger (model_weights) until the bundle is collected."""
         if self._dev_vars is None:
+            import weakref
+
             import jax
 
+            from mmlspark_tpu.obs.memory import device_label, memory_ledger
             from mmlspark_tpu.utils.profiling import dataplane_counters
 
             nbytes = sum(
@@ -653,6 +657,16 @@ class NetworkBundle:
             )
             dataplane_counters().record_h2d(nbytes)
             self._dev_vars = jax.device_put(self.variables)
+            led = memory_ledger()
+            if led.enabled and nbytes > 0:
+                leaves = jax.tree_util.tree_leaves(self._dev_vars)
+                dev = device_label(leaves[0] if leaves else None)
+                owner = f"bundle-{id(self)}"
+                led.record_alloc(dev, "model_weights", nbytes, owner=owner)
+                # the ledger entry lives exactly as long as the cached device
+                # tree: collecting the bundle drops the arrays AND the bytes
+                weakref.finalize(self, led.record_free, dev, "model_weights",
+                                 nbytes, owner)
         return self._dev_vars
 
     def save_to_dir(self, path: str) -> None:

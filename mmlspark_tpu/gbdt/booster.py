@@ -167,14 +167,28 @@ class Booster:
             packed = self._pack()
             if packed is None:
                 return None
+            import weakref
+
             import jax
+
+            from mmlspark_tpu.obs.memory import device_label, memory_ledger
 
             arrays = {
                 k: v for k, v in packed.items() if isinstance(v, np.ndarray)
             }
-            _counters().record_h2d(sum(a.nbytes for a in arrays.values()))
+            nbytes = sum(a.nbytes for a in arrays.values())
+            _counters().record_h2d(nbytes)
             self._packed_dev = dict(packed)
             self._packed_dev.update(jax.device_put(arrays))
+            led = memory_ledger()
+            if led.enabled and nbytes > 0:
+                first = next(iter(arrays))
+                dev = device_label(self._packed_dev[first])
+                owner = f"booster-{id(self)}"
+                led.record_alloc(dev, "model_weights", nbytes, owner=owner)
+                # resident exactly as long as the cached device ensemble
+                weakref.finalize(self, led.record_free, dev, "model_weights",
+                                 nbytes, owner)
         return self._packed_dev
 
     def _walk_device(self, x):

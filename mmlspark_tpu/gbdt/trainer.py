@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -106,6 +106,95 @@ def _record_boost_device_work(engine: str, shards: int, seconds: float,
             site=f"gbdt:{engine}:per_device", model="gbdt_per_device",
             seconds=seconds, flops=flops / shards,
         )
+
+
+#: fault-injection hook (bench/tests only): shard index -> extra seconds
+#: slept inside the timed per-shard dispatch segment, so an injected slow
+#: shard exercises the exact code path a straggling chip would. None = off.
+_SHARD_DELAY_FN: Optional[Callable[[int], float]] = None
+
+
+class _ShardSkewMeter:
+    """Per-round shard-skew telemetry for the sharded GBDT engines.
+
+    Per-shard device pass seconds accumulate over one boosting round;
+    `end_round` reports slowest/median as `gbdt_shard_skew_ratio{engine}`
+    (1.0 = perfectly balanced) and fires ONE structured
+    `gbdt_shard_straggler` warning + a span event when the SAME shard
+    stays > `gbdt.straggler.factor` x median for `gbdt.straggler.rounds`
+    consecutive rounds — a persistently slow chip on a real pod, visible
+    before it burns the SLO budget instead of after. Instantiated only
+    while the obs layer is enabled (callers pass None otherwise), so the
+    disabled arm pays nothing."""
+
+    def __init__(self, engine: str, labels: Dict[Any, str]):
+        from mmlspark_tpu.core.config import get as _cfg_get
+
+        self.engine = engine
+        self.labels = dict(labels)  # shard key -> device label
+        self.factor = float(_cfg_get("gbdt.straggler.factor", 3.0))
+        self.rounds_needed = max(1, int(_cfg_get("gbdt.straggler.rounds", 2)))
+        self._acc: Dict[Any, float] = {}
+        self._streak_key: Any = None
+        self._streak = 0
+        self._warned = False
+        reg = obs_registry()
+        self._gauge = reg.gauge(
+            "gbdt_shard_skew_ratio",
+            "Slowest/median per-shard device seconds for the most recent "
+            "boosting round (1.0 = perfectly balanced shards)",
+            ("engine",),
+        )
+        self._warn_total = reg.counter(
+            "gbdt_straggler_warnings_total",
+            "Persistent-straggler warnings fired by GBDT shard-skew "
+            "telemetry",
+            ("engine",),
+        )
+
+    def add(self, key: Any, seconds: float) -> None:
+        self._acc[key] = self._acc.get(key, 0.0) + seconds
+
+    def end_round(self, span: Any = None) -> Optional[float]:
+        """Close one boosting round; returns the skew ratio (None when
+        fewer than two shards reported)."""
+        times = {k: v for k, v in self._acc.items() if v > 0}
+        self._acc = {}
+        if len(times) < 2:
+            return None
+        med = float(np.median(sorted(times.values())))
+        if med <= 0:
+            return None
+        slow_key = max(times, key=lambda k: times[k])
+        ratio = times[slow_key] / med
+        self._gauge.labels(engine=self.engine).set(ratio)
+        if ratio > self.factor:
+            if slow_key == self._streak_key:
+                self._streak += 1
+            else:
+                self._streak_key, self._streak = slow_key, 1
+                self._warned = False
+        else:
+            self._streak_key, self._streak = None, 0
+            self._warned = False
+        if self._streak >= self.rounds_needed and not self._warned:
+            self._warned = True
+            label = self.labels.get(slow_key, str(slow_key))
+            self._warn_total.labels(engine=self.engine).inc()
+            get_logger("mmlspark_tpu.gbdt").warning(
+                "gbdt_shard_straggler", engine=self.engine,
+                shard=str(slow_key), device=label,
+                skew_ratio=round(ratio, 3), rounds=self._streak,
+                factor=self.factor,
+                shard_seconds=round(times[slow_key], 4),
+                median_seconds=round(med, 4),
+            )
+            if span is not None and getattr(span, "recording", False):
+                span.add_event(
+                    "gbdt_straggler", shard=str(slow_key), device=label,
+                    skew_ratio=round(ratio, 3), rounds=self._streak,
+                )
+        return ratio
 
 
 class _ValidTracker:
@@ -412,6 +501,11 @@ def train_booster(
     y_host = np.asarray(y, np.float64)
     import math
 
+    from mmlspark_tpu.utils.profiling import dataplane_counters
+
+    # every fused-engine upload is counted (graftcheck untracked-device-upload)
+    counters = dataplane_counters()
+
     if jax.device_count() > 1 and not _FORCE_SINGLE_DEVICE:
         from mmlspark_tpu.parallel.mesh import batch_sharding, data_parallel_mesh
 
@@ -420,11 +514,16 @@ def train_booster(
 
         def shard(a):
             a = np.asarray(a)
+            counters.record_h2d(a.nbytes)
             return jax.device_put(a, batch_sharding(mesh, a.ndim))
 
     else:
         nd = 1
-        shard = jax.device_put
+
+        def shard(a):
+            a = np.asarray(a)
+            counters.record_h2d(a.nbytes)
+            return jax.device_put(a)
 
     n_base = n + ((-n) % 1024)  # device-count-invariant bagging draw length
     # Row pad: the size-adaptive pallas kernel block (compute.hist_block);
@@ -677,14 +776,16 @@ def train_booster(
                 fm = np.ones(f, bool)
             fmask_rows.append(fm)
 
+        bank_host = np.stack(mask_bank)
+        counters.record_h2d(bank_host.nbytes)
         if nd > 1:
             from mmlspark_tpu.parallel.mesh import batch_sharding
 
             bank_dev = jax.device_put(
-                np.stack(mask_bank), batch_sharding(mesh, 2, axis=1)
+                bank_host, batch_sharding(mesh, 2, axis=1)
             )
         else:
-            bank_dev = jax.device_put(np.stack(mask_bank))
+            bank_dev = jax.device_put(bank_host)
         w_arg = w_dev if w_dev is not None else y_dev
         vrows = np.flatnonzero(valid_mask) if has_valid else None
         t_boost = time.perf_counter()
@@ -845,9 +946,14 @@ def train_booster(
                 bag_mask = train_rows & goss_mask
                 amp = np.ones(n, np.float32)
                 amp[rest_idx] = (1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12)
+                counters.record_h2d(amp.nbytes)
                 sample_amp = jax.device_put(amp)
 
-            mask_dev = jax.device_put(bag_mask) if (use_bagging or goss_mode) else train_mask_dev
+            if use_bagging or goss_mode:
+                counters.record_h2d(bag_mask.nbytes)
+                mask_dev = jax.device_put(bag_mask)
+            else:
+                mask_dev = train_mask_dev
 
             # -- grow k trees -------------------------------------------------------
             # dart must materialize host trees immediately (drop bookkeeping
@@ -860,6 +966,7 @@ def train_booster(
                 keep = frng.choice(f, size=n_keep, replace=False)
                 feature_mask = np.zeros(f, bool)
                 feature_mask[keep] = True
+                counters.record_h2d(feature_mask.nbytes)
                 fmask_dev = jax.device_put(feature_mask)
 
             for c in range(k):
@@ -1332,6 +1439,17 @@ def _train_booster_streamed(
         )
         owners = [devices[u % len(devices)] for u in units]
         n_shards = len({u % len(devices) for u in units})
+    # shard-skew telemetry for the sharded streamed path: per-chunk pass
+    # time attributed to the chunk's OWNER device (None = single device or
+    # obs disabled — zero overhead)
+    skew = None
+    if owners is not None and n_shards > 1 and obs_registry().enabled:
+        from mmlspark_tpu.obs.memory import device_label
+
+        skew = _ShardSkewMeter(
+            "streamed",
+            {device_label(d): device_label(d) for d in devices},
+        )
     # Streamed chunks ride the Pallas route+hist kernel on a single real
     # TPU chip (chunks padded to the kernel block in the stage step); the
     # einsum path stays for CPU and for sharded streams, whose replicated
@@ -1460,13 +1578,15 @@ def _train_booster_streamed(
                     int(grow_cfg.max_cat_threshold),
                     n_bins_static, cat_static,
                     np.float32(cfg.learning_rate), grow_cfg, binner,
-                    hist_impl=hist_impl, owners=owners,
+                    hist_impl=hist_impl, owners=owners, skew=skew,
                 )
                 trees.append(tree)
                 if k > 1:
                     raw[:, c] += leaf_vals[assign]
                 else:
                     raw += leaf_vals[assign]
+            if skew is not None:
+                skew.end_round(boost_span)
             # per-round device seconds + hist-pass MFU: the streamed loop
             # is device-synchronous (every chunk pass lands in np.asarray),
             # so the round wall IS queue+device time; no-op when disabled
@@ -1534,6 +1654,7 @@ def _stream_grow_tree(
     binner: BinMapper,
     hist_impl: str = "einsum",
     owners: Optional[List[Any]] = None,
+    skew: Optional["_ShardSkewMeter"] = None,
 ):
     """Grow ONE leaf-wise tree with streamed histogram passes.
 
@@ -1594,10 +1715,17 @@ def _stream_grow_tree(
         acc = np.zeros((F, B, 3), np.float32)
         ids = list(ids)
         placement = (lambda ci: owners[ci]) if owners is not None else None
+        if skew is not None and owners is not None:
+            from mmlspark_tpu.obs.memory import device_label
+
+            owner_label = [device_label(o) for o in owners]
+        else:
+            owner_label = None
 
         with DeviceChunkPrefetcher(
             iter(ids), stage, depth=2, placement=placement
         ) as pf:
+            t_prev = time.perf_counter()
             for pos, dev in enumerate(pf):
                 ci = ids[pos]
                 na, hist_c = route_hist_chunk(
@@ -1616,6 +1744,12 @@ def _stream_grow_tree(
                     counts[ci, new_slot] = int((na_h == new_slot).sum())
                 acc += np.asarray(hist_c)
                 visits.inc()
+                if owner_label is not None:
+                    # whole loop-iteration elapsed (wait + kernel + fetch)
+                    # attributed to this chunk's owner device
+                    now = time.perf_counter()
+                    skew.add(owner_label[ci], now - t_prev)
+                    t_prev = now
         return acc
 
     return _grow_tree_hostdriven(
@@ -1952,6 +2086,17 @@ def _train_booster_data_parallel(
     phase_hist.labels(phase="shard_upload").observe(
         time.perf_counter() - t_up
     )
+    # per-shard resident payload (device-memory ledger, data_shards class):
+    # equal slices, so every device holds the same byte count — the bag
+    # mask (uploaded below, and re-uploaded same-size on bagging redraws)
+    # is included here once
+    per_shard_nbytes = (
+        bins_p[:m].nbytes + y32[:m].nbytes
+        + (0 if w32 is None else w32[:m].nbytes)
+        + raw0[:m].nbytes
+        + m * np.dtype(np.int32).itemsize  # assign
+        + m * np.dtype(bool).itemsize      # bag mask
+    )
     del bins_p, raw0
 
     if w32 is None:
@@ -1988,6 +2133,14 @@ def _train_booster_data_parallel(
         for i, (lo, hi) in enumerate(bounds)
     ]
 
+    from mmlspark_tpu.obs.memory import device_label, memory_ledger
+
+    led = memory_ledger()
+    shards_ledgered = led.enabled
+    if shards_ledgered:
+        led.record_alloc_devices(devices, "data_shards", per_shard_nbytes,
+                                 owner="gbdt:dp_fit")
+
     trees: List[Any] = list(init_model.trees) if init_model is not None else []
     start_iter = len(trees) // k
     counts = np.zeros((nd, cfg.num_leaves), np.int64)
@@ -2011,6 +2164,13 @@ def _train_booster_data_parallel(
         learning_rate=cfg.learning_rate,
     )
     dp_passes = _stream_metrics()["dp_passes"]
+    skew = (
+        _ShardSkewMeter(
+            "data_parallel",
+            {i: device_label(d) for i, d in enumerate(devices)},
+        )
+        if obs_registry().enabled and nd > 1 else None
+    )
 
     # per-class device gradient handles the shard_pass closure reads; the
     # iteration loop rebinds them before each tree
@@ -2021,11 +2181,16 @@ def _train_booster_data_parallel(
                    route: bool):
         """Dispatch the listed shards' route+hist kernels (queued async —
         concurrent across devices on a pod), then reduce the fetched
-        histograms in FIXED shard-index order."""
+        histograms in FIXED shard-index order. Each shard's dispatch
+        segment and reduce wait feed the skew meter, so a chip that takes
+        longer than its peers shows up as that SHARD's time."""
         ids = list(ids)
         member = np.asarray(member, bool)
         pending = []
         for i in ids:
+            t0 = time.perf_counter() if skew is not None else 0.0
+            if _SHARD_DELAY_FN is not None:
+                time.sleep(_SHARD_DELAY_FN(i))
             na, hist_i, cnt_i = route_hist_shard(
                 bins_d[i], gc_d[i], hc_d[i], mask_d[i], assign_d[i],
                 member, np.int32(feat), np.int32(slot),
@@ -2035,13 +2200,18 @@ def _train_booster_data_parallel(
             )
             assign_d[i] = na
             pending.append((i, hist_i, cnt_i))
+            if skew is not None:
+                skew.add(i, time.perf_counter() - t0)
         acc = np.zeros((f, num_bins, 3), np.float32)
         for i, hist_i, cnt_i in pending:  # shard-index order == ids order
+            t0 = time.perf_counter() if skew is not None else 0.0
             acc += np.asarray(hist_i)
             if route:
                 c2 = np.asarray(cnt_i)
                 counts[i, slot] = int(c2[0])
                 counts[i, new_slot] = int(c2[1])
+            if skew is not None:
+                skew.add(i, time.perf_counter() - t0)
         dp_passes.inc(len(ids))
         return acc
 
@@ -2104,6 +2274,8 @@ def _train_booster_data_parallel(
                         raw_d[i] = add_leaf_outputs(
                             raw_d[i], assign_d[i], leaf_vals
                         )
+            if skew is not None:
+                skew.end_round(boost_span)
             _record_boost_device_work(
                 "data_parallel", nd, time.perf_counter() - t_round, 1,
                 n_orig, f, num_bins, cfg.num_leaves, k,
@@ -2116,6 +2288,9 @@ def _train_booster_data_parallel(
         phase_hist.labels(phase="boost_data_parallel").observe(
             time.perf_counter() - t_boost
         )
+        if shards_ledgered:
+            led.record_free_devices(devices, "data_shards",
+                                    per_shard_nbytes, owner="gbdt:dp_fit")
 
     booster = Booster(
         trees,

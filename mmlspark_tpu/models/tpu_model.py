@@ -106,6 +106,32 @@ def forward_program_count(net: Network) -> int:
     )
 
 
+def _track_replicated_weights(variables, mesh) -> None:
+    """Account a mesh-replicated weight upload in the device-memory ledger:
+    one full copy is resident on EVERY mesh device for exactly as long as
+    the replicated tree lives — a GC finalizer on the first leaf frees the
+    bytes when _eval_batches' local tree is collected (all leaves share the
+    tree's lifetime)."""
+    import weakref
+
+    import jax
+
+    from mmlspark_tpu.obs.memory import memory_ledger
+
+    led = memory_ledger()
+    if not led.enabled:
+        return
+    leaves = jax.tree_util.tree_leaves(variables)
+    nbytes = sum(getattr(leaf, "nbytes", 0) for leaf in leaves)
+    if not leaves or nbytes <= 0:
+        return
+    devices = list(mesh.devices.flat)
+    owner = "tpu_model:mesh_weights"
+    led.record_alloc_devices(devices, "model_weights", nbytes, owner=owner)
+    weakref.finalize(leaves[0], led.record_free_devices, devices,
+                     "model_weights", nbytes, owner)
+
+
 def extract_feature_matrix(col, in_shape, col_name: str = "features",
                            prefer_device: bool = False) -> Any:
     """DataFrame Column -> (n, *in_shape) array, shared by TPUModel and
@@ -342,6 +368,7 @@ class TPUModel(Model, Wrappable):
             variables = jax.device_put(
                 bundle.variables, replicated_sharding(mesh)
             )
+            _track_replicated_weights(variables, mesh)
             in_shard = batch_sharding(mesh, ndim=x.ndim)
         else:
             variables = bundle.device_variables()  # uploaded once per bundle

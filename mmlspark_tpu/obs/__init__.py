@@ -31,6 +31,11 @@ One subsystem every layer reports into, scrapeable over HTTP
   sampled device timing, and a bounded per-dispatch flight recorder served
   at ``GET /debug/flight`` (``GET /debug/trace`` serves the tracer ring as
   Chrome trace_event JSON).
+- **Device memory** (`obs.memory`): the `DeviceMemoryLedger` — resident
+  device bytes per (device, class) with high-watermarks, HBM-pressure
+  gauges, a growth-trend leak detector, and a `jax.live_arrays()`
+  truth-check (`device_ledger_drift_total`); served at
+  ``GET /debug/memory``.
 - **Structured logging** (`obs.logging`): JSON-lines log records stamped
   with the active span's trace/span ids — the library's only log emitter
   (pinned by graftcheck's `unstructured-log-in-library` rule).
@@ -46,6 +51,12 @@ import contextlib
 from typing import Iterator
 
 from mmlspark_tpu.obs.logging import StructuredLogger, get_logger
+from mmlspark_tpu.obs.memory import (
+    CLASSES,
+    DeviceMemoryLedger,
+    device_label,
+    memory_ledger,
+)
 from mmlspark_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -97,6 +108,10 @@ __all__ = [
     "DeviceProfiler",
     "device_profiler",
     "profiler_sampling",
+    "CLASSES",
+    "DeviceMemoryLedger",
+    "device_label",
+    "memory_ledger",
     "set_enabled",
     "disabled",
 ]

@@ -1,0 +1,562 @@
+"""Device-memory ledger: per-device resident-byte accounting by class.
+
+The flow-observability layers (PR 5 metrics, PR 13 MFU/flight, PR 14
+tracing/SLO) answer "what is the device *doing*"; nothing answered "what
+is resident in HBM on device k right now, and who owns it" — the question
+both ROADMAP tentpoles (the HBM byte-budget manager and pod-scale GSPMD
+training) start from. This module is that accounting:
+
+- **Ledger.** ``memory_ledger()`` is the process singleton. Every
+  framework allocation/free of device-resident bytes reports
+  ``record_alloc`` / ``record_free`` with a device, a **class** (one of
+  ``CLASSES``: model_weights, dispatch_programs, data_shards,
+  prefetch_chunks, scratch) and an optional owner tag. Gauges:
+  ``device_resident_bytes{device,class}`` (live),
+  ``device_resident_bytes_peak{device,class}`` (high-watermark) and
+  ``device_memory_pressure{device}`` (total resident / the per-kind HBM
+  capacity table in core/env.py).
+- **Leak detection.** Per class, the ledger keeps a short growth trend
+  (samples between frees): a class that only grows across
+  ``leak_min_samples`` allocations, by more than ``leak_growth_frac``
+  (with a bytes floor), earns ONE structured ``device_memory_leak``
+  warning carrying the class, per-device breakdown, top owners and the
+  active trace id, plus ``device_memory_leak_warnings_total{class}``. Any
+  free of that class resets the trend — growth that drains is churn, not
+  a leak.
+- **Truth-check.** ``reconcile()`` samples ``jax.live_arrays()`` and
+  compares per-device live bytes against the ledger's ARRAY-BACKED
+  classes. The invariant is ``ledger <= live + tolerance`` — the ledger
+  tracks a *subset* of live arrays (jit temporaries, constants and user
+  arrays are legitimately unattributed), so live exceeding the ledger is
+  reported (``unattributed_bytes``) but only a ledger claiming MORE than
+  exists (phantom residency: a free site that never decremented) counts
+  as drift, incrementing ``device_ledger_drift_total{device}`` and
+  logging the discrepancy. dispatch_programs is excluded from the
+  comparison — XLA executables hold real device memory that
+  ``live_arrays()`` can never confirm — and reported separately as
+  ``executable_bytes``. ``GET /debug/memory`` (serving/server.py and the
+  gateway) serves ``debug_payload()`` — snapshot, watermarks, pressure,
+  last reconcile, top-N owners — and re-reconciles when the last check
+  is stale.
+
+Wired call sites: ``NetworkBundle.device_variables`` and the mesh
+replicated-weights upload (model_weights), ``DispatchCache`` AOT
+executable retention/eviction (dispatch_programs — evictions decrement),
+``Booster._packed_device`` (model_weights), the
+``DeviceChunkPrefetcher`` chunk lifecycle including PR 15 owner-device
+placement (prefetch_chunks), and the data-parallel GBDT trainer's
+per-shard resident state (data_shards). graftcheck's
+``untracked-device-upload`` rule keeps new dataplane upload sites from
+bypassing this accounting (docs/static-analysis.md).
+
+Rollback parity: every recording method no-ops under
+``obs.set_enabled(False)`` / ``obs.disabled()`` — gated <= 5% overhead by
+``bench.run_memory_smoke`` (BENCH_pr16.json).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from mmlspark_tpu.obs.logging import get_logger
+from mmlspark_tpu.obs.metrics import registry
+from mmlspark_tpu.obs.tracing import current_span
+
+__all__ = [
+    "CLASSES",
+    "DeviceMemoryLedger",
+    "device_label",
+    "memory_ledger",
+]
+
+log = get_logger("mmlspark_tpu.obs")
+
+#: the resident-byte classes the ledger accounts by — every framework
+#: allocation belongs to exactly one
+CLASSES = (
+    "model_weights",
+    "dispatch_programs",
+    "data_shards",
+    "prefetch_chunks",
+    "scratch",
+)
+
+#: growth-trend samples (allocations with no intervening free) before a
+#: class can be called a leak (config: obs.memory.leak.min.samples)
+DEFAULT_LEAK_MIN_SAMPLES = 16
+#: net growth fraction over the trend start before warning
+DEFAULT_LEAK_GROWTH_FRAC = 0.5
+#: absolute growth floor — a near-zero start must still grow by this much
+DEFAULT_LEAK_MIN_GROWTH_BYTES = 1 << 20
+#: reconcile tolerance: phantom bytes allowed before drift counts, as a
+#: fraction of live bytes and an absolute floor
+DEFAULT_DRIFT_TOL_FRAC = 0.05
+DEFAULT_DRIFT_TOL_BYTES = 1 << 20
+#: /debug/memory re-reconciles when the last truth-check is older than this
+DEFAULT_RECONCILE_STALE_S = 60.0
+#: owner-table retention bound (top-N attribution, not the totals)
+_MAX_OWNERS = 512
+
+
+def device_label(device: Any) -> str:
+    """Stable registry label for a device-ish value: a jax Device becomes
+    ``platform:id`` ("tpu:3", "cpu:0"); a single-device Sharding or a
+    device-resident array resolves through its device set; a string passes
+    through; None/unresolvable become "unknown" (callers that know better
+    pass better)."""
+    if device is None:
+        return "unknown"
+    if isinstance(device, str):
+        return device
+    platform = getattr(device, "platform", None)
+    dev_id = getattr(device, "id", None)
+    if platform is not None and dev_id is not None:
+        return f"{platform}:{dev_id}"
+    devs = getattr(device, "device_set", None)  # Sharding
+    if devs is None:
+        get_devs = getattr(device, "devices", None)  # jax.Array
+        if callable(get_devs):
+            try:
+                devs = get_devs()
+            except Exception:  # committed-elsewhere array; label, not truth  # graftcheck: ignore[broad-except]
+                devs = None
+    if devs:
+        devs = sorted(devs, key=lambda d: getattr(d, "id", 0))
+        if len(devs) == 1:
+            return device_label(devs[0])
+        return "mesh"
+    return "unknown"
+
+
+def default_device_label() -> str:
+    """The label of jax's default device (imports jax — call lazily)."""
+    import jax
+
+    # a LABEL probe, not a placement: single-device uploads commit to the
+    # default device and the ledger names it
+    return device_label(jax.devices()[0])  # graftcheck: ignore[hardcoded-device-index]
+
+
+class DeviceMemoryLedger:
+    """Thread-safe resident-byte accounting per (device, class); one
+    process-wide instance (``memory_ledger()``), registry-backed like the
+    DeviceProfiler it sits beside. Every recording method is a no-op while
+    the observability layer is disabled — callers must then also skip the
+    matching frees, which ``obs.disabled()`` scopes do symmetrically."""
+
+    def __init__(self,
+                 leak_min_samples: Optional[int] = None,
+                 leak_growth_frac: Optional[float] = None,
+                 leak_min_growth_bytes: Optional[int] = None,
+                 drift_tol_frac: Optional[float] = None,
+                 drift_tol_bytes: Optional[int] = None):
+        from mmlspark_tpu.core.config import get as _cfg_get
+
+        if leak_min_samples is None:
+            leak_min_samples = int(_cfg_get(
+                "obs.memory.leak.min.samples", DEFAULT_LEAK_MIN_SAMPLES))
+        if leak_growth_frac is None:
+            leak_growth_frac = float(_cfg_get(
+                "obs.memory.leak.growth.frac", DEFAULT_LEAK_GROWTH_FRAC))
+        if leak_min_growth_bytes is None:
+            leak_min_growth_bytes = int(_cfg_get(
+                "obs.memory.leak.min.growth.bytes",
+                DEFAULT_LEAK_MIN_GROWTH_BYTES))
+        if drift_tol_frac is None:
+            drift_tol_frac = float(_cfg_get(
+                "obs.memory.drift.tol.frac", DEFAULT_DRIFT_TOL_FRAC))
+        if drift_tol_bytes is None:
+            drift_tol_bytes = int(_cfg_get(
+                "obs.memory.drift.tol.bytes", DEFAULT_DRIFT_TOL_BYTES))
+        self._lock = threading.Lock()
+        self.leak_min_samples = max(2, int(leak_min_samples))
+        self.leak_growth_frac = float(leak_growth_frac)
+        self.leak_min_growth_bytes = int(leak_min_growth_bytes)
+        self.drift_tol_frac = float(drift_tol_frac)
+        self.drift_tol_bytes = int(drift_tol_bytes)
+        # (device, class) -> resident bytes; the source of truth
+        self._resident: Dict[Tuple[str, str], int] = {}
+        self._peaks: Dict[Tuple[str, str], int] = {}
+        self._dev_peaks: Dict[str, int] = {}
+        # (device, class, owner) -> bytes; bounded top-N attribution only
+        self._owners: "OrderedDict[Tuple[str, str, str], int]" = OrderedDict()
+        # class -> [(monotonic_t, class_total), ...] growth trend; cleared
+        # by any free of that class
+        self._trend: Dict[str, List[Tuple[float, int]]] = {}
+        self._leak_warned: Dict[str, bool] = {}
+        self._leak_events: "deque" = deque(maxlen=32)
+        self._last_reconcile: Optional[Dict[str, Any]] = None
+        self._last_reconcile_t: float = 0.0
+        self._capacity: Optional[float] = None  # lazy (imports jax)
+
+        reg = registry()
+        self._resident_gauge = reg.gauge(
+            "device_resident_bytes",
+            "Framework-attributed resident device bytes by class",
+            ("device", "class"),
+        )
+        self._peak_gauge = reg.gauge(
+            "device_resident_bytes_peak",
+            "High-water mark of framework-attributed resident device bytes",
+            ("device", "class"),
+        )
+        self._pressure_gauge = reg.gauge(
+            "device_memory_pressure",
+            "Total attributed resident bytes / per-device HBM capacity "
+            "(core/env.py table; absent when capacity is unknown)",
+            ("device",),
+        )
+        self._drift_total = reg.counter(
+            "device_ledger_drift_total",
+            "Reconcile passes where the ledger claimed more resident bytes "
+            "than jax.live_arrays() holds (beyond tolerance)",
+            ("device",),
+        )
+        self._leak_total = reg.counter(
+            "device_memory_leak_warnings_total",
+            "Growth-trend leak warnings emitted, by resident-byte class",
+            ("class",),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return registry().enabled
+
+    # -- recording -------------------------------------------------------------
+
+    def record_alloc(self, device: Any, cls: str, nbytes: int,
+                     owner: Optional[str] = None) -> None:
+        """`nbytes` became resident on `device` under class `cls`."""
+        self._record(device, cls, int(nbytes), owner)
+
+    def record_free(self, device: Any, cls: str, nbytes: int,
+                    owner: Optional[str] = None) -> None:
+        """`nbytes` previously recorded for (device, cls) were released."""
+        self._record(device, cls, -int(nbytes), owner)
+
+    def record_alloc_devices(self, devices, cls: str, nbytes_per_device: int,
+                             owner: Optional[str] = None) -> None:
+        """A replicated allocation: `nbytes_per_device` resident on EACH of
+        `devices` (a mesh-replicated weight tree holds one full copy per
+        chip)."""
+        for d in devices:
+            self._record(d, cls, int(nbytes_per_device), owner)
+
+    def record_free_devices(self, devices, cls: str, nbytes_per_device: int,
+                            owner: Optional[str] = None) -> None:
+        for d in devices:
+            self._record(d, cls, -int(nbytes_per_device), owner)
+
+    def _record(self, device: Any, cls: str, delta: int,
+                owner: Optional[str]) -> None:
+        if delta == 0 or not self.enabled:
+            return
+        if cls not in CLASSES:
+            cls = "scratch"
+        dev = device_label(device)
+        leak = None
+        with self._lock:
+            key = (dev, cls)
+            total = max(0, self._resident.get(key, 0) + delta)
+            self._resident[key] = total
+            if total > self._peaks.get(key, 0):
+                self._peaks[key] = total
+            dev_total = sum(
+                v for (d, _), v in self._resident.items() if d == dev
+            )
+            if dev_total > self._dev_peaks.get(dev, 0):
+                self._dev_peaks[dev] = dev_total
+            if owner is not None:
+                okey = (dev, cls, str(owner))
+                obytes = self._owners.get(okey, 0) + delta
+                if obytes <= 0:
+                    self._owners.pop(okey, None)
+                else:
+                    self._owners[okey] = obytes
+                    self._owners.move_to_end(okey)
+                    while len(self._owners) > _MAX_OWNERS:
+                        self._owners.popitem(last=False)
+            if delta > 0:
+                leak = self._note_growth(cls)
+            else:
+                # a free is the anti-leak signal: the trend restarts, and
+                # a once-warned class earns a fresh warning if it leaks
+                # again later
+                self._trend.pop(cls, None)
+                self._leak_warned.pop(cls, None)
+        self._resident_gauge.labels(device=dev, **{"class": cls}).set(
+            float(total))
+        self._peak_gauge.labels(device=dev, **{"class": cls}).set_max(
+            float(total))
+        cap = self._hbm_capacity()
+        if cap > 0:
+            self._pressure_gauge.labels(device=dev).set(dev_total / cap)
+        if leak is not None:
+            self._warn_leak(cls, leak)
+
+    def _note_growth(self, cls: str) -> Optional[Dict[str, Any]]:
+        """Append a growth sample for `cls` (lock held); returns the leak
+        payload when the trend crosses the threshold un-warned."""
+        total = sum(v for (_, c), v in self._resident.items() if c == cls)
+        trend = self._trend.setdefault(cls, [])
+        trend.append((time.monotonic(), total))
+        if len(trend) > 4 * self.leak_min_samples:
+            del trend[0]
+        if len(trend) < self.leak_min_samples or self._leak_warned.get(cls):
+            return None
+        start = trend[0][1]
+        growth = total - start
+        threshold = max(self.leak_min_growth_bytes,
+                        int(self.leak_growth_frac * start))
+        if growth < threshold:
+            return None
+        self._leak_warned[cls] = True
+        by_device = {
+            d: v for (d, c), v in self._resident.items()
+            if c == cls and v > 0
+        }
+        owners = sorted(
+            ((o, v) for (d, c, o), v in self._owners.items() if c == cls),
+            key=lambda kv: -kv[1],
+        )[:5]
+        return {
+            "class": cls,
+            "samples": len(trend),
+            "start_bytes": start,
+            "now_bytes": total,
+            "growth_bytes": growth,
+            "by_device": by_device,
+            "top_owners": owners,
+        }
+
+    def _warn_leak(self, cls: str, payload: Dict[str, Any]) -> None:
+        span = current_span()
+        trace_id = (
+            span.trace_id if span is not None and span.recording else None
+        )
+        payload = dict(payload, trace_id=trace_id)
+        with self._lock:
+            self._leak_events.append(payload)
+        self._leak_total.labels(**{"class": cls}).inc()
+        log.warning(
+            "device_memory_leak",
+            **{"class": cls},
+            samples=payload["samples"],
+            start_bytes=payload["start_bytes"],
+            now_bytes=payload["now_bytes"],
+            growth_bytes=payload["growth_bytes"],
+            by_device=payload["by_device"],
+            top_owners=payload["top_owners"],
+            trace_id=trace_id,
+        )
+
+    # -- truth-check -----------------------------------------------------------
+
+    def live_device_bytes(self) -> Dict[str, float]:
+        """Per-device live bytes from jax.live_arrays() (each array's bytes
+        split evenly across its device set). The reconcile baseline — also
+        what the bench's delta-based tolerance gate samples directly."""
+        import jax
+
+        live: Dict[str, float] = {}
+        for arr in jax.live_arrays():
+            try:
+                if arr.is_deleted():
+                    continue
+                devs = list(arr.sharding.device_set)
+                nbytes = float(arr.nbytes)
+            except Exception:  # arrays may be deleted mid-iteration; skip  # graftcheck: ignore[broad-except]
+                continue
+            if not devs:
+                continue
+            share = nbytes / len(devs)
+            for d in devs:
+                lbl = device_label(d)
+                live[lbl] = live.get(lbl, 0.0) + share
+        return live
+
+    def reconcile(self) -> Dict[str, Any]:
+        """One truth-check pass: per device, the ledger's ARRAY-BACKED
+        total vs live bytes. `unattributed_bytes` (live > ledger) is
+        informational — jit constants/temporaries and user arrays are
+        legitimately untracked; `phantom_bytes` (ledger > live) beyond
+        tolerance is drift: a free site that never decremented. Drift
+        increments ``device_ledger_drift_total{device}`` and logs a
+        warning. The dispatch_programs class is excluded from the phantom
+        comparison — XLA executables hold real device memory but are not
+        jax arrays, so ``jax.live_arrays()`` can never confirm them; their
+        bytes are reported per device as ``executable_bytes`` instead."""
+        if not self.enabled:
+            return {"skipped": "observability disabled"}
+        live = self.live_device_bytes()
+        with self._lock:
+            ledger: Dict[str, int] = {}
+            execs: Dict[str, int] = {}
+            for (d, c), v in self._resident.items():
+                if c == "dispatch_programs":
+                    execs[d] = execs.get(d, 0) + v
+                else:
+                    ledger[d] = ledger.get(d, 0) + v
+        devices: Dict[str, Dict[str, float]] = {}
+        drifted: List[str] = []
+        for dev in sorted(set(live) | set(ledger) | set(execs)):
+            lv = live.get(dev, 0.0)
+            lg = float(ledger.get(dev, 0))
+            tol = max(float(self.drift_tol_bytes),
+                      self.drift_tol_frac * max(lv, lg))
+            phantom = max(0.0, lg - lv)
+            drift = phantom > tol
+            devices[dev] = {
+                "ledger_bytes": lg,
+                "live_bytes": round(lv, 1),
+                "executable_bytes": float(execs.get(dev, 0)),
+                "unattributed_bytes": round(max(0.0, lv - lg), 1),
+                "phantom_bytes": round(phantom, 1),
+                "tolerance_bytes": round(tol, 1),
+                "within_tolerance": not drift,
+            }
+            if drift:
+                drifted.append(dev)
+                self._drift_total.labels(device=dev).inc()
+        result = {
+            "devices": devices,
+            "drifted": drifted,
+            "checked_at": round(time.time(), 3),
+        }
+        with self._lock:
+            self._last_reconcile = result
+            self._last_reconcile_t = time.monotonic()
+        if drifted:
+            log.warning(
+                "device_ledger_drift",
+                drifted=drifted,
+                devices={d: devices[d] for d in drifted},
+            )
+        return result
+
+    # -- views -----------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        """{device: {class: resident_bytes}} for all nonzero entries."""
+        with self._lock:
+            out: Dict[str, Dict[str, int]] = {}
+            for (d, c), v in self._resident.items():
+                if v > 0:
+                    out.setdefault(d, {})[c] = v
+            return out
+
+    def total_bytes(self, device: Optional[Any] = None) -> int:
+        with self._lock:
+            if device is None:
+                return sum(self._resident.values())
+            dev = device_label(device)
+            return sum(
+                v for (d, _), v in self._resident.items() if d == dev
+            )
+
+    def watermarks(self) -> Dict[str, Dict[str, int]]:
+        """{device: {class: peak_bytes, "_total": device_peak}}."""
+        with self._lock:
+            out: Dict[str, Dict[str, int]] = {}
+            for (d, c), v in self._peaks.items():
+                if v > 0:
+                    out.setdefault(d, {})[c] = v
+            for d, v in self._dev_peaks.items():
+                if v > 0:
+                    out.setdefault(d, {})["_total"] = v
+            return out
+
+    def top_owners(self, n: int = 10) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = sorted(
+                self._owners.items(), key=lambda kv: -kv[1]
+            )[:max(0, int(n))]
+        return [
+            {"device": d, "class": c, "owner": o, "bytes": v}
+            for (d, c, o), v in rows
+        ]
+
+    def leak_events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._leak_events]
+
+    def debug_payload(self, top_n: int = 10,
+                      reconcile: str = "auto") -> Dict[str, Any]:
+        """The ``GET /debug/memory`` payload: per-device snapshot,
+        watermarks, pressure, last truth-check, leak events and top-N
+        owners. ``reconcile="auto"`` runs a fresh truth-check when the last
+        one is missing or stale; "never" serves whatever is cached
+        (tests/disabled paths)."""
+        from mmlspark_tpu.core.config import get as _cfg_get
+
+        stale_s = float(_cfg_get(
+            "obs.memory.reconcile.stale.seconds", DEFAULT_RECONCILE_STALE_S))
+        if reconcile == "always" or (
+            reconcile == "auto" and self.enabled and (
+                self._last_reconcile is None
+                or time.monotonic() - self._last_reconcile_t > stale_s
+            )
+        ):
+            self.reconcile()
+        cap = self._hbm_capacity()
+        with self._lock:
+            last = self._last_reconcile
+        snap = self.snapshot()
+        pressure = {
+            d: round(sum(by_cls.values()) / cap, 6)
+            for d, by_cls in snap.items()
+        } if cap > 0 else {}
+        return {
+            "classes": list(CLASSES),
+            "resident": snap,
+            "total_bytes": self.total_bytes(),
+            "watermarks": self.watermarks(),
+            "hbm_capacity_bytes": cap,
+            "pressure": pressure,
+            "reconcile": last,
+            "drift_total": {
+                "/".join(lbls): int(child.value())
+                for lbls, child in self._drift_total.children()
+            },
+            "leak_events": self.leak_events(),
+            "top_owners": self.top_owners(top_n),
+        }
+
+    def _hbm_capacity(self) -> float:
+        if self._capacity is None:
+            from mmlspark_tpu.core.env import hbm_bytes_per_device
+
+            try:
+                self._capacity = float(hbm_bytes_per_device())
+            except Exception as e:  # backend not initializable: omit
+                log.debug("hbm_capacity_unavailable", error=repr(e))
+                self._capacity = 0.0
+        return self._capacity
+
+    def clear(self) -> None:
+        """Drop all ledger state (tests); registry series persist but the
+        live gauges zero out."""
+        with self._lock:
+            entries = list(self._resident.items())
+            self._resident.clear()
+            self._peaks.clear()
+            self._dev_peaks.clear()
+            self._owners.clear()
+            self._trend.clear()
+            self._leak_warned.clear()
+            self._leak_events.clear()
+            self._last_reconcile = None
+            self._last_reconcile_t = 0.0
+        for (d, c), _ in entries:
+            self._resident_gauge.labels(device=d, **{"class": c}).set(0.0)
+
+
+_LEDGER = DeviceMemoryLedger()
+
+
+def memory_ledger() -> DeviceMemoryLedger:
+    """The process-wide device-memory ledger singleton."""
+    return _LEDGER

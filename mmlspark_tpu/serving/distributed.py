@@ -58,7 +58,11 @@ from mmlspark_tpu.serving.fabric import (
     ServingFabric,
 )
 from mmlspark_tpu.serving.faults import FaultInjector
-from mmlspark_tpu.serving.server import ServingServer, _trace_payload
+from mmlspark_tpu.serving.server import (
+    ServingServer,
+    _memory_payload,
+    _trace_payload,
+)
 
 log = get_logger("mmlspark_tpu.serving")
 
@@ -568,6 +572,16 @@ class DistributedServingServer:
                     self._send_body(
                         200, "OK",
                         json.dumps(device_profiler().flight(),
+                                   sort_keys=True).encode("utf-8"),
+                        "application/json",
+                    )
+                    return
+                if route == "/debug/memory":
+                    # the device-memory ledger is process-wide, so the
+                    # gateway serves the same snapshot its workers would
+                    self._send_body(
+                        200, "OK",
+                        json.dumps(_memory_payload(self.path),
                                    sort_keys=True).encode("utf-8"),
                         "application/json",
                     )

@@ -644,6 +644,13 @@ class ServingServer:
                     ).encode("utf-8")
                     self._send(HTTPResponseData.ok(body))
                     return
+                if route == "/debug/memory":
+                    self._drain_body()
+                    body = json.dumps(
+                        _memory_payload(self.path), sort_keys=True
+                    ).encode("utf-8")
+                    self._send(HTTPResponseData.ok(body))
+                    return
                 if route == "/debug/trace":
                     self._drain_body()
                     # ?trace_id= serves the assembled cross-hop TREE for
@@ -1363,6 +1370,29 @@ def _trace_payload(path: str) -> Dict[str, Any]:
     if tid:
         return obs_tracer().trace_tree(tid)
     return obs_tracer().chrome_trace()
+
+
+def _memory_payload(path: str) -> Dict[str, Any]:
+    """The GET /debug/memory body: the device-memory ledger's per-device
+    snapshot, watermarks, pressure, last truth-check and top-N owners
+    (obs/memory.py). `?top_n=` widens the owner list; `?reconcile=always`
+    forces a fresh jax.live_arrays() truth-check on this request (the
+    default re-checks lazily when the last one is stale). Shared by
+    ServingServer and the distributed gateway (same process ledger)."""
+    import urllib.parse
+
+    from mmlspark_tpu.obs.memory import memory_ledger
+
+    query = path.split("?", 1)[1] if "?" in path else ""
+    opts = urllib.parse.parse_qs(query)
+    try:
+        top_n = int(opts.get("top_n", ["10"])[-1])
+    except ValueError:
+        top_n = 10
+    mode = opts.get("reconcile", ["auto"])[-1]
+    if mode not in ("auto", "always", "never"):
+        mode = "auto"
+    return memory_ledger().debug_payload(top_n=top_n, reconcile=mode)
 
 
 def _status(code: int, reason: str, body: bytes = b"") -> HTTPResponseData:

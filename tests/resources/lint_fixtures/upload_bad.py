@@ -1,0 +1,63 @@
+"""Fixture for the untracked-device-upload rule: parsed, never imported.
+
+Each upload below either lacks counting evidence in its scope (flagged),
+carries evidence (clean), or is explicitly suppressed."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu.core.prefetch import upload_host_chunk
+
+_WEIGHTS = jax.device_put(np.zeros(4))  # expect[untracked-device-upload]
+
+
+def bad_bare_upload(host):
+    return jax.device_put(host)  # expect[untracked-device-upload]
+
+
+def bad_sharded_upload(host, sharding):
+    staged = jax.device_put(host, sharding)  # expect[untracked-device-upload]
+    return jnp.asarray(host, device=sharding)  # expect[untracked-device-upload]
+
+
+def bad_nested_scope_is_judged_alone(counters, host):
+    # evidence OUTSIDE the nested function does not count for it
+    counters.record_h2d(host.nbytes)
+
+    def put(a):
+        return jax.device_put(a)  # expect[untracked-device-upload]
+
+    return put(host)
+
+
+def suppressed_scratch_upload(mask):
+    # bounded scratch whose residency is deliberately unledgered
+    return jax.device_put(mask)  # expect-suppressed[untracked-device-upload]  # graftcheck: ignore[untracked-device-upload]
+
+
+def clean_via_upload_host_chunk(host, device):
+    return upload_host_chunk(host, device)
+
+
+def clean_counted_upload(counters, host):
+    counters.record_h2d(host.nbytes)
+    return jax.device_put(host)
+
+
+def clean_ledgered_upload(memory_ledger, host, dev):
+    led = memory_ledger()
+    staged = jax.device_put(host)
+    led.record_alloc(dev, "data_shards", host.nbytes)
+    return staged
+
+
+def clean_asarray_without_device(host):
+    # dtype coercion stays wherever its input lives: not an upload
+    return jnp.asarray(host, dtype=jnp.float32)
+
+
+def clean_alias_without_call():
+    # aliasing is not uploading; call sites are judged in their own scope
+    shard = jax.device_put
+    return shard

@@ -1,0 +1,685 @@
+"""Device-memory ledger + shard-skew telemetry (ISSUE 16).
+
+Covers the ledger's accounting invariants (alloc/free exactness under an
+N-thread hammer, watermarks, owner attribution, disabled no-op), the
+growth-trend leak detector (fires once, a free re-arms it), the
+``reconcile()`` truth-check against ``jax.live_arrays()`` (clean on real
+arrays, phantom residency counted as drift), every wired call site
+(bundle weight GC, dispatch-cache eviction decrement, prefetch chunk
+lifecycle including the two-live-prefetcher peak-gauge regression, the
+data-parallel trainer's shard state), the shard-skew meter with a
+fault-injected straggler, ``GET /debug/memory`` against a live
+ServingServer and the distributed gateway, and a scrape-vs-lifecycle
+race hammer on the registry render paths.
+"""
+
+import gc
+import http.client
+import json
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs.memory import (
+    CLASSES,
+    DeviceMemoryLedger,
+    device_label,
+    memory_ledger,
+)
+from mmlspark_tpu.obs.metrics import registry
+
+
+def _quiet_ledger(**kw):
+    """A private ledger whose leak detector cannot fire by accident."""
+    kw.setdefault("leak_min_growth_bytes", 1 << 40)
+    return DeviceMemoryLedger(**kw)
+
+
+def _cls_total(led, cls):
+    return sum(
+        by_cls.get(cls, 0) for by_cls in led.snapshot().values()
+    )
+
+
+# -- accounting ---------------------------------------------------------------
+
+
+class TestLedgerAccounting:
+    def test_alloc_free_exact(self):
+        led = _quiet_ledger()
+        led.record_alloc("cpu:0", "model_weights", 1000, owner="a")
+        led.record_alloc("cpu:1", "data_shards", 500, owner="b")
+        assert led.snapshot() == {
+            "cpu:0": {"model_weights": 1000},
+            "cpu:1": {"data_shards": 500},
+        }
+        assert led.total_bytes() == 1500
+        assert led.total_bytes("cpu:0") == 1000
+        led.record_free("cpu:0", "model_weights", 1000, owner="a")
+        led.record_free("cpu:1", "data_shards", 500, owner="b")
+        assert led.snapshot() == {}
+        assert led.total_bytes() == 0
+
+    def test_unknown_class_routes_to_scratch(self):
+        led = _quiet_ledger()
+        led.record_alloc("cpu:0", "definitely-not-a-class", 64)
+        assert led.snapshot() == {"cpu:0": {"scratch": 64}}
+
+    def test_watermarks_survive_frees(self):
+        led = _quiet_ledger()
+        led.record_alloc("cpu:0", "model_weights", 100)
+        led.record_alloc("cpu:0", "data_shards", 200)
+        led.record_free("cpu:0", "model_weights", 100)
+        led.record_free("cpu:0", "data_shards", 200)
+        marks = led.watermarks()["cpu:0"]
+        assert marks["model_weights"] == 100
+        assert marks["data_shards"] == 200
+        assert marks["_total"] == 300  # both classes were resident at once
+
+    def test_replicated_device_recording(self):
+        led = _quiet_ledger()
+        devs = ["cpu:0", "cpu:1", "cpu:2"]
+        led.record_alloc_devices(devs, "model_weights", 64, owner="rep")
+        assert led.total_bytes() == 3 * 64
+        for d in devs:
+            assert led.snapshot()[d] == {"model_weights": 64}
+        led.record_free_devices(devs, "model_weights", 64, owner="rep")
+        assert led.total_bytes() == 0
+
+    def test_owner_table_attribution(self):
+        led = _quiet_ledger()
+        led.record_alloc("cpu:0", "scratch", 10, owner="small")
+        led.record_alloc("cpu:0", "scratch", 90, owner="big")
+        top = led.top_owners(1)
+        assert top == [
+            {"device": "cpu:0", "class": "scratch", "owner": "big",
+             "bytes": 90}
+        ]
+        assert len(led.top_owners(10)) == 2
+
+    def test_disabled_recording_is_noop(self):
+        led = _quiet_ledger()
+        with obs.disabled():
+            led.record_alloc("cpu:0", "scratch", 4096, owner="ghost")
+        assert led.total_bytes() == 0
+        assert led.snapshot() == {}
+
+    def test_thread_hammer_exact_total(self):
+        """PR 5 exactness contract: N threads of interleaved alloc/free
+        must land on the arithmetically exact resident total."""
+        led = _quiet_ledger()
+        n_threads, n_iter, nbytes = 8, 200, 64
+        errors = []
+
+        def work(tid):
+            try:
+                dev = f"cpu:{tid % 4}"
+                for i in range(n_iter):
+                    led.record_alloc(dev, "scratch", nbytes,
+                                     owner=f"t{tid}")
+                    if i % 2 == 0:
+                        led.record_free(dev, "scratch", nbytes,
+                                        owner=f"t{tid}")
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=work, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        # each thread nets n_iter/2 allocations of `nbytes`
+        assert led.total_bytes() == n_threads * (n_iter // 2) * nbytes
+
+    def test_device_label_forms(self):
+        import jax
+
+        dev = jax.devices()[0]
+        assert device_label(dev) == f"{dev.platform}:{dev.id}"
+        assert device_label("tpu:5") == "tpu:5"
+        assert device_label(None) == "unknown"
+        arr = jax.device_put(np.zeros(4, np.float32))
+        assert device_label(arr) == device_label(dev)
+
+
+# -- leak detector ------------------------------------------------------------
+
+
+class TestLeakDetector:
+    def _leaky_ledger(self):
+        return DeviceMemoryLedger(
+            leak_min_samples=4, leak_growth_frac=0.1,
+            leak_min_growth_bytes=1024,
+        )
+
+    def test_fires_once_with_payload(self, caplog):
+        led = self._leaky_ledger()
+        before = registry().counter(
+            "device_memory_leak_warnings_total", "", ("class",)
+        ).labels(**{"class": "scratch"}).value()
+        with caplog.at_level(logging.WARNING, logger="mmlspark_tpu.obs"):
+            for _ in range(8):
+                led.record_alloc("cpu:0", "scratch", 4096, owner="leaky")
+        events = led.leak_events()
+        assert len(events) == 1  # warned ONCE despite continued growth
+        ev = events[0]
+        assert ev["class"] == "scratch"
+        assert ev["samples"] >= 4
+        assert ev["growth_bytes"] >= 1024
+        assert set(ev["by_device"]) == {"cpu:0"}
+        assert ev["by_device"]["cpu:0"] > 0
+        assert ev["top_owners"][0][0] == "leaky"
+        assert "trace_id" in ev
+        after = registry().counter(
+            "device_memory_leak_warnings_total", "", ("class",)
+        ).labels(**{"class": "scratch"}).value()
+        assert after == before + 1
+        payloads = [
+            json.loads(r.getMessage()) for r in caplog.records
+            if "device_memory_leak" in r.message
+        ]
+        assert len(payloads) == 1
+        assert payloads[0]["class"] == "scratch"
+        assert payloads[0]["growth_bytes"] == ev["growth_bytes"]
+
+    def test_free_resets_trend_and_rearms(self):
+        led = self._leaky_ledger()
+        for _ in range(8):
+            led.record_alloc("cpu:0", "scratch", 4096)
+        assert len(led.leak_events()) == 1
+        # growth that drains is churn, not a leak — and the class earns a
+        # FRESH warning if it starts leaking again afterwards
+        led.record_free("cpu:0", "scratch", 4096)
+        for _ in range(8):
+            led.record_alloc("cpu:0", "scratch", 4096)
+        assert len(led.leak_events()) == 2
+
+    def test_draining_class_never_warns(self):
+        led = self._leaky_ledger()
+        for _ in range(32):
+            led.record_alloc("cpu:0", "scratch", 4096)
+            led.record_free("cpu:0", "scratch", 4096)
+        assert led.leak_events() == []
+
+
+# -- reconcile truth-check ----------------------------------------------------
+
+
+class TestReconcile:
+    def test_clean_on_real_arrays(self):
+        import jax
+
+        led = _quiet_ledger()
+        arr = jax.device_put(np.zeros(1024, np.float32))
+        arr.block_until_ready()
+        led.record_alloc(device_label(arr), "scratch", arr.nbytes,
+                         owner="truth")
+        report = led.reconcile()
+        assert report["drifted"] == []
+        dev = report["devices"][device_label(arr)]
+        assert dev["ledger_bytes"] == float(arr.nbytes)
+        assert dev["within_tolerance"]
+        # live >= ledger: the surplus is unattributed, never drift
+        assert dev["phantom_bytes"] <= dev["tolerance_bytes"]
+
+    def test_phantom_residency_counts_as_drift(self):
+        led = _quiet_ledger(drift_tol_frac=0.0, drift_tol_bytes=1024)
+        phantom_dev = "cpu:7"
+        before = registry().counter(
+            "device_ledger_drift_total", "", ("device",)
+        ).labels(device=phantom_dev).value()
+        # claim a gigabyte that no live array backs: a free site that
+        # never decremented
+        led.record_alloc(phantom_dev, "scratch", 1 << 30, owner="phantom")
+        report = led.reconcile()
+        assert phantom_dev in report["drifted"]
+        assert not report["devices"][phantom_dev]["within_tolerance"]
+        assert report["devices"][phantom_dev]["phantom_bytes"] > 0
+        after = registry().counter(
+            "device_ledger_drift_total", "", ("device",)
+        ).labels(device=phantom_dev).value()
+        assert after == before + 1
+
+    def test_executables_never_count_as_phantom(self):
+        """XLA executables hold real device memory live_arrays() can
+        never confirm — dispatch_programs is excluded from the phantom
+        comparison and reported separately."""
+        led = _quiet_ledger(drift_tol_frac=0.0, drift_tol_bytes=1024)
+        led.record_alloc("cpu:6", "dispatch_programs", 1 << 30,
+                         owner="programs")
+        report = led.reconcile()
+        assert report["drifted"] == []
+        dev = report["devices"]["cpu:6"]
+        assert dev["executable_bytes"] == float(1 << 30)
+        assert dev["within_tolerance"]
+
+    def test_disabled_reconcile_skips(self):
+        led = _quiet_ledger()
+        with obs.disabled():
+            assert "skipped" in led.reconcile()
+
+    def test_debug_payload_schema(self):
+        led = _quiet_ledger()
+        led.record_alloc("cpu:0", "model_weights", 256, owner="schema")
+        payload = led.debug_payload(top_n=3, reconcile="always")
+        for key in ("classes", "resident", "total_bytes", "watermarks",
+                    "hbm_capacity_bytes", "pressure", "reconcile",
+                    "drift_total", "leak_events", "top_owners"):
+            assert key in payload, key
+        assert payload["classes"] == list(CLASSES)
+        assert payload["total_bytes"] == 256
+        assert payload["resident"]["cpu:0"]["model_weights"] == 256
+        assert payload["reconcile"] is not None
+        assert "devices" in payload["reconcile"]
+        assert json.loads(json.dumps(payload)) == json.loads(
+            json.dumps(payload))  # JSON-serializable end to end
+
+    def test_clear_zeroes_ledger(self):
+        led = _quiet_ledger()
+        led.record_alloc("cpu:0", "scratch", 512, owner="gone")
+        led.clear()
+        assert led.total_bytes() == 0
+        assert led.snapshot() == {}
+        assert led.watermarks() == {}
+        assert led.leak_events() == []
+
+
+# -- wired call sites ---------------------------------------------------------
+
+
+class TestWiredSites:
+    def test_bundle_weights_freed_on_gc(self):
+        import jax
+
+        from mmlspark_tpu.dnn.network import Network, NetworkBundle
+
+        led = memory_ledger()
+        gc.collect()
+        baseline = _cls_total(led, "model_weights")
+        net = Network(
+            [{"kind": "dense", "units": 8}, {"kind": "dense", "units": 2}],
+            (6,),
+        )
+        bundle = NetworkBundle(net, net.init(jax.random.PRNGKey(0)))
+        expected = sum(
+            leaf.nbytes
+            for leaf in jax.tree_util.tree_leaves(bundle.variables)
+            if hasattr(leaf, "nbytes")
+        )
+        bundle.device_variables()
+        assert _cls_total(led, "model_weights") == baseline + expected
+        del bundle
+        gc.collect()
+        # the finalizer rides the cached device tree's lifetime
+        assert _cls_total(led, "model_weights") == baseline
+
+    def test_dispatch_eviction_decrements_ledger(self):
+        """Satellite 2 regression: evicting an AOT program at
+        max_programs must give its bytes back to the ledger."""
+        import jax
+
+        from mmlspark_tpu.core.dispatch import DispatchCache
+
+        led = memory_ledger()
+        baseline = _cls_total(led, "dispatch_programs")
+        cache = DispatchCache(max_programs=2)
+        x = np.ones(16, np.float32)
+        try:
+            for i in range(4):
+                fn = jax.jit(lambda a, s=float(i + 2): a * s)
+                out = cache.aot_program(
+                    ("mem16", i), ("f32", 16), fn, (x,), site="test"
+                )
+                assert out is not None
+                # the ledger's delta is exactly the bytes of the <= 2
+                # retained programs, at every step of the eviction loop
+                with cache._lock:
+                    tracked = sum(
+                        nb for nb, _ in cache._aot_sizes.values()
+                    )
+                    assert len(cache._aot) <= 2
+                assert (
+                    _cls_total(led, "dispatch_programs") - baseline
+                    == tracked
+                )
+        finally:
+            cache.clear()
+        assert _cls_total(led, "dispatch_programs") == baseline
+
+    def test_prefetch_chunks_resident_then_released(self):
+        from mmlspark_tpu.core.prefetch import DeviceChunkPrefetcher
+
+        led = memory_ledger()
+        baseline = _cls_total(led, "prefetch_chunks")
+        payload = {"x": np.zeros(8192, np.uint8)}
+        pf = DeviceChunkPrefetcher(
+            iter(range(5)), lambda i: dict(payload), depth=2
+        )
+        it = iter(pf)
+        next(it)
+        # the producer stages ahead asynchronously — wait for a parked
+        # chunk to become observably resident
+        deadline = time.monotonic() + 10.0
+        mid = 0
+        while time.monotonic() < deadline:
+            mid = _cls_total(led, "prefetch_chunks") - baseline
+            if mid > 0:
+                break
+            time.sleep(0.005)
+        assert mid > 0
+        for _ in it:
+            pass
+        pf.close()
+        assert _cls_total(led, "prefetch_chunks") == baseline
+
+    def test_close_releases_parked_chunks(self):
+        from mmlspark_tpu.core.prefetch import DeviceChunkPrefetcher
+
+        led = memory_ledger()
+        baseline = _cls_total(led, "prefetch_chunks")
+        pf = DeviceChunkPrefetcher(
+            iter(range(8)),
+            lambda i: {"x": np.zeros(4096, np.uint8)},
+            depth=3,
+        )
+        it = iter(pf)
+        next(it)  # start the producer, leave chunks parked
+        pf.close()  # abandon mid-stream
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if _cls_total(led, "prefetch_chunks") == baseline:
+                break
+            time.sleep(0.005)
+        assert _cls_total(led, "prefetch_chunks") == baseline
+
+    def test_two_live_prefetchers_peak_is_max(self):
+        """Satellite 1 regression: the resident-peak gauge must report
+        the MAX over all live pipelines, not the last writer."""
+        from mmlspark_tpu.core import prefetch as prefetch_mod
+        from mmlspark_tpu.core.prefetch import DeviceChunkPrefetcher
+
+        big_nbytes = 1 << 16
+        big = DeviceChunkPrefetcher(
+            iter(range(3)),
+            lambda i: {"x": np.zeros(big_nbytes, np.uint8)},
+            depth=2,
+        )
+        big_it = iter(big)
+        next(big_it)
+        deadline = time.monotonic() + 10.0
+        while (big._state.resident_peak < big_nbytes
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        assert big._state.resident_peak >= big_nbytes
+        small = DeviceChunkPrefetcher(
+            iter(range(3)),
+            lambda i: {"x": np.zeros(256, np.uint8)},
+            depth=2,
+        )
+        small_it = iter(small)
+        next(small_it)
+        # a last-writer-wins gauge would now report the small pipeline
+        assert prefetch_mod._resident_peak_now() >= big_nbytes
+        for _ in big_it:
+            pass
+        for _ in small_it:
+            pass
+        big.close()
+        small.close()
+        # the finished loop's peak still anchors the gauge
+        assert prefetch_mod._resident_peak_now() >= big_nbytes
+
+
+# -- shard skew + data-parallel lifecycle -------------------------------------
+
+
+def _dp_fit(n=2048, f=8, **cfg_kw):
+    from mmlspark_tpu.gbdt.objectives import make_objective
+    from mmlspark_tpu.gbdt.trainer import TrainConfig, train_booster
+
+    rng = np.random.default_rng(16)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+    cfg_kw.setdefault("num_iterations", 4)
+    cfg_kw.setdefault("num_leaves", 7)
+    cfg_kw.setdefault("max_bin", 15)
+    cfg_kw.setdefault("verbosity", 0)
+    cfg_kw.setdefault("engine", "data_parallel")
+    return train_booster(
+        x, y, make_objective("binary", num_class=2), TrainConfig(**cfg_kw)
+    )
+
+
+class TestShardSkew:
+    def test_balanced_fit_reports_ratio_and_frees_shards(self):
+        import jax
+
+        if jax.device_count() < 8:
+            pytest.skip("needs the 8-device host platform")
+        led = memory_ledger()
+        baseline = _cls_total(led, "data_shards")
+        _dp_fit()
+        ratio = registry().gauge(
+            "gbdt_shard_skew_ratio", "", ("engine",)
+        ).labels(engine="data_parallel").value()
+        assert ratio >= 1.0  # slowest/median is >= 1 by construction
+        # per-shard resident state is returned to the ledger after fit
+        assert _cls_total(led, "data_shards") == baseline
+
+    def test_fault_injected_straggler_warns(self, caplog):
+        import jax
+
+        from mmlspark_tpu.gbdt import trainer as trainer_mod
+
+        if jax.device_count() < 8:
+            pytest.skip("needs the 8-device host platform")
+        counter = registry().counter(
+            "gbdt_straggler_warnings_total", "", ("engine",)
+        ).labels(engine="data_parallel")
+        before = counter.value()
+        trainer_mod._SHARD_DELAY_FN = (
+            lambda i: 0.05 if i == 3 else 0.0
+        )
+        try:
+            with caplog.at_level(
+                logging.WARNING, logger="mmlspark_tpu.gbdt"
+            ):
+                _dp_fit()
+        finally:
+            trainer_mod._SHARD_DELAY_FN = None
+        assert counter.value() >= before + 1
+        ratio = registry().gauge(
+            "gbdt_shard_skew_ratio", "", ("engine",)
+        ).labels(engine="data_parallel").value()
+        assert ratio > 3.0  # the delayed shard dominates the round
+        warns = [
+            json.loads(r.getMessage()) for r in caplog.records
+            if "gbdt_shard_straggler" in r.message
+        ]
+        assert warns, "no structured straggler warning"
+        w = warns[0]
+        assert w["engine"] == "data_parallel"
+        assert w["shard"] == "3"
+        assert w["skew_ratio"] > 3.0
+        assert w["rounds"] >= 2  # persistent, not a one-round blip
+        assert w["device"]  # straggler names its device
+
+
+# -- /debug/memory live-server integration ------------------------------------
+
+
+def _post(port, route, payload):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    conn.request("POST", route, json.dumps(payload).encode(),
+                 {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+def _get(port, route):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    conn.request("GET", route)
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+def _small_model(tag=16):
+    import jax
+
+    from mmlspark_tpu.dnn.network import Network, NetworkBundle
+    from mmlspark_tpu.models import TPUModel
+
+    net = Network(
+        [{"kind": "dense", "units": 8}, {"kind": "dense", "units": 2}],
+        (4,),
+    )
+    bundle = NetworkBundle(net, net.init(jax.random.PRNGKey(tag)))
+    return TPUModel(bundle, input_col="x", output_col="y",
+                    mini_batch_size=8)
+
+
+def _model_handler():
+    from mmlspark_tpu.core.dataframe import DataType
+    from mmlspark_tpu.serving import (
+        StagedServingHandler,
+        make_reply,
+        parse_request,
+    )
+
+    model = _small_model()
+
+    class Staged(StagedServingHandler):
+        def parse(self, df):
+            parsed = parse_request(df, {"x": (DataType.VECTOR, 4)})
+            parsed.column("x").device_values()
+            return parsed
+
+        def score(self, df):
+            return model.transform(df)
+
+        def reply(self, df):
+            return make_reply(df, "y")
+
+    return Staged()
+
+
+class TestDebugMemoryEndpoint:
+    def test_live_server_attributes_serving_classes(self):
+        from mmlspark_tpu.serving import ServingServer
+
+        with ServingServer(
+            _model_handler(), api_name="mem16", mode="micro_batch"
+        ) as srv:
+            for i in range(2):
+                status, _ = _post(srv.port, "/mem16", {"x": [float(i)] * 4})
+                assert status == 200
+            status, body = _get(srv.port, "/debug/memory?reconcile=always")
+            assert status == 200
+            payload = json.loads(body)
+            for key in ("classes", "resident", "total_bytes", "watermarks",
+                        "pressure", "reconcile", "drift_total",
+                        "leak_events", "top_owners"):
+                assert key in payload, key
+            assert payload["classes"] == list(CLASSES)
+            resident_classes = {
+                c for by_cls in payload["resident"].values() for c in by_cls
+            }
+            # a featurize->score request leaves its weights AND its AOT
+            # programs attributed
+            assert "model_weights" in resident_classes
+            assert "dispatch_programs" in resident_classes
+            assert payload["total_bytes"] > 0
+            assert payload["reconcile"]["devices"]
+            # the request's truth-check found no phantom residency (the
+            # retained AOT executables report as executable_bytes, not
+            # phantom)
+            assert payload["reconcile"]["drifted"] == []
+            exec_reported = sum(
+                d["executable_bytes"]
+                for d in payload["reconcile"]["devices"].values()
+            )
+            exec_resident = sum(
+                by_cls.get("dispatch_programs", 0)
+                for by_cls in payload["resident"].values()
+            )
+            assert exec_reported == float(exec_resident) > 0
+            status, body = _get(srv.port, "/debug/memory?top_n=1")
+            assert status == 200
+            assert len(json.loads(body)["top_owners"]) <= 1
+
+    def test_gateway_serves_debug_memory(self):
+        from mmlspark_tpu.serving import DistributedServingServer
+
+        with DistributedServingServer(
+            _model_handler, n_workers=2, api_name="gwmem16",
+            mode="micro_batch",
+        ) as srv:
+            status, _ = _post(srv.port, "/gwmem16", {"x": [1.0] * 4})
+            assert status == 200
+            status, body = _get(srv.port, "/debug/memory")
+            assert status == 200
+            payload = json.loads(body)
+            assert payload["classes"] == list(CLASSES)
+            assert payload["total_bytes"] >= 0
+            assert "model_weights" in {
+                c for by_cls in payload["resident"].values() for c in by_cls
+            }
+
+
+# -- scrape-vs-lifecycle race -------------------------------------------------
+
+
+class TestScrapeRace:
+    def test_scrapes_race_prefetcher_lifecycle(self):
+        """Scraper threads hammer the registry render paths (including
+        the set_function peak gauge walking the live-pipeline set) while
+        prefetchers churn through create/consume/close — no exceptions,
+        no torn renders."""
+        from mmlspark_tpu.core.prefetch import DeviceChunkPrefetcher
+
+        stop = threading.Event()
+        errors = []
+
+        def scrape():
+            try:
+                while not stop.is_set():
+                    registry().render_prometheus()
+                    registry().render_scrape("")
+            except Exception as e:
+                errors.append(e)
+
+        scrapers = [
+            threading.Thread(target=scrape) for _ in range(4)
+        ]
+        for t in scrapers:
+            t.start()
+        try:
+            for cycle in range(10):
+                pf = DeviceChunkPrefetcher(
+                    iter(range(3)),
+                    lambda i: {"x": np.zeros(2048, np.uint8)},
+                    depth=2,
+                )
+                it = iter(pf)
+                next(it)
+                if cycle % 2 == 0:
+                    for _ in it:
+                        pass
+                pf.close()
+        finally:
+            stop.set()
+            for t in scrapers:
+                t.join(timeout=10.0)
+        assert not errors, errors

@@ -698,6 +698,63 @@ def test_unstructured_log_exempts_obs_logging_module(tmp_path):
     assert {f.path for f in findings} == {"other.py"}
 
 
+# -- untracked device uploads --------------------------------------------------
+
+
+def test_untracked_upload_fires_and_suppresses():
+    from mmlspark_tpu.analysis.untracked_upload import check_untracked_upload
+
+    path = os.path.join(FIXTURES, "upload_bad.py")
+    findings = check_untracked_upload([path], repo_root=FIXTURES)
+    _assert_matches_markers("upload_bad.py", findings)
+
+
+def test_untracked_upload_allows_counted_scopes():
+    """upload_host_chunk routing, record_h2d-counted scopes, ledgered
+    scopes, asarray without device=, and bare aliases must stay silent."""
+    from mmlspark_tpu.analysis.untracked_upload import check_untracked_upload
+
+    path = os.path.join(FIXTURES, "upload_bad.py")
+    findings = check_untracked_upload([path], repo_root=FIXTURES)
+    with open(path) as f:
+        clean_line = next(
+            i for i, line in enumerate(f, start=1)
+            if "def clean_via_upload_host_chunk" in line
+        )
+    assert findings and all(f.line < clean_line for f in findings), findings
+
+
+def test_untracked_upload_scoped_to_dataplane_tier(tmp_path):
+    """run_all only feeds the dataplane-tier modules to the rule: the same
+    bare device_put in, say, serving/ is another tier's business."""
+    pkg = tmp_path / "mmlspark_tpu"
+    bad_src = (
+        "import jax\n\n"
+        "def stage(host):\n"
+        "    return jax.device_put(host)\n"
+    )
+    for sub, name in (("core", "dataframe.py"), ("serving", "mod.py")):
+        d = pkg / sub
+        d.mkdir(parents=True)
+        (d / "__init__.py").write_text("")
+        (d / name).write_text(bad_src)
+    (pkg / "__init__.py").write_text("")
+    findings = run_all(
+        root=str(tmp_path), select=["untracked-device-upload"]
+    )
+    paths = {f.path for f in findings}
+    assert os.path.join("mmlspark_tpu", "core", "dataframe.py") in paths
+    assert not any("serving" in p for p in paths), paths
+
+
+def test_untracked_upload_package_scan_clean():
+    """ISSUE 16 satellite: every dataplane-tier upload is counted — the
+    column/prefetch/mesh record_h2d sites, the weight uploads' ledger
+    records, and the fused GBDT engine's counted shard/mask uploads."""
+    findings = run_all(root=REPO, select=["untracked-device-upload"])
+    assert findings == [], [str(f) for f in findings]
+
+
 # -- hardcoded device index ----------------------------------------------------
 
 
