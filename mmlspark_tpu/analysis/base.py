@@ -46,6 +46,8 @@ RULES: Dict[str, str] = {
     "hardcoded-device-index": "scalar index into jax.devices()/jax.local_devices() pins work to one device outside a single-device-guarded branch; place through the mesh or a shard->device ownership map",
     # untracked-upload family (untracked_upload.py)
     "untracked-device-upload": "jax.device_put/jnp.asarray(device=) upload in a dataplane module whose scope shows no counting evidence (upload_host_chunk/record_h2d/memory_ledger); invisible H2D bytes are what make /debug/memory reconciliation drift",
+    # train-loop family (train_loop.py)
+    "per-step-host-sync-in-train-loop": "float()/.item()/np.asarray()/block_until_ready() on a jitted step's result inside a fit*/train* for-loop serializes async dispatch; accumulate device scalars and device_get once per epoch",
     # Params-contract family (params_contract.py)
     "param-converter": "simple Param declared without an explicit type converter",
     "param-doc": "stage or Param missing documentation",

@@ -193,6 +193,7 @@ def run_all(
             os.path.join(package_name, "core", "prefetch.py"),
             os.path.join(package_name, "parallel", "mesh.py"),
             os.path.join(package_name, "models", "tpu_model.py"),
+            os.path.join(package_name, "models", "tpu_learner.py"),
             os.path.join(package_name, "dnn", "network.py"),
             os.path.join(package_name, "gbdt", "booster.py"),
             os.path.join(package_name, "gbdt", "trainer.py"),
@@ -202,6 +203,24 @@ def run_all(
             [
                 p for p in package_files
                 if os.path.relpath(p, root) in upload_files
+            ],
+            repo_root=root,
+        )
+    if "per-step-host-sync-in-train-loop" in enabled:
+        from mmlspark_tpu.analysis.train_loop import check_train_loop
+
+        # scoped to the training tiers: models/ and automl/ own the
+        # fit*/train* epoch loops whose throughput the PR 18 pipeline
+        # bought — a per-step float(loss) there silently reverts the
+        # async dispatch back to lock-step (docs/dnn-training.md)
+        train_dirs = (
+            os.path.join(package_name, "models") + os.sep,
+            os.path.join(package_name, "automl") + os.sep,
+        )
+        findings += check_train_loop(
+            [
+                p for p in package_files
+                if os.path.relpath(p, root).startswith(train_dirs)
             ],
             repo_root=root,
         )

@@ -1,0 +1,191 @@
+"""Train-loop rule: per-step host syncs must not serialize async dispatch.
+
+`per-step-host-sync-in-train-loop` flags, inside any ``for``-loop body of a
+function or method whose name starts with ``fit`` or ``train`` (leading
+underscores ignored — the training hot loops of models/ and automl/):
+
+- ``float(X)`` / ``int(X)`` / ``X.item()`` on a device value — a
+  one-element fetch that blocks the Python thread until EVERY dispatched
+  step retires, turning the async step pipeline back into lock-step
+  (exactly the PR 18 `float(loss)` regression this rule encodes);
+- ``np.asarray(X)`` on a device value — the same sync, whole-array;
+- ``X.block_until_ready()`` / ``jax.block_until_ready(X)`` — the explicit
+  form of the stall.
+
+"Device value" is intraprocedural taint: names bound from calls of a
+jit-compiled function (a name assigned from ``jax.jit(...)`` / ``pjit``),
+propagated through tuple unpacking and simple name-to-name assignment.
+The fix is the accumulate-then-fetch idiom (models/tpu_learner.py): append
+device scalars to a list and ``jax.device_get`` them ONCE per epoch,
+outside the step loop. A genuine per-step sync (a debugging harness, a
+convergence early-exit that must read the loss) takes a justified
+``# graftcheck: ignore[per-step-host-sync-in-train-loop]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Set
+
+from mmlspark_tpu.analysis.base import Finding
+
+_RULE = "per-step-host-sync-in-train-loop"
+_CASTS = {"float", "int"}
+_SYNC_ATTRS = {"item", "block_until_ready"}
+
+
+def _is_train_fn(node: ast.AST) -> bool:
+    return (
+        isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name.lstrip("_").startswith(("fit", "train"))
+    )
+
+
+def _jit_fn_names(fn: ast.AST) -> Set[str]:
+    """Names bound to a jit-compiled callable anywhere in the function:
+    `step = jax.jit(f)`, `step = pjit(f)`, including conditional forms
+    like `step = jax.jit(f, donate_argnums=...) if ok else jax.jit(f)`."""
+
+    def has_jit_call(expr: ast.AST) -> bool:
+        for sub in ast.walk(expr):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if isinstance(f, ast.Name) and f.id in ("jit", "pjit"):
+                return True
+            if isinstance(f, ast.Attribute) and f.attr in ("jit", "pjit"):
+                return True
+        return False
+
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and has_jit_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _tainted_names(fn: ast.AST, jit_fns: Set[str]) -> Set[str]:
+    """Names holding (values derived from) a jitted call's result, via
+    direct assignment, tuple unpacking, or name-to-name propagation.
+    Document-order single pass — the hot-path rule's simplification."""
+
+    tainted: Set[str] = set()
+
+    def value_tainted(expr: ast.AST) -> bool:
+        for sub in ast.walk(expr):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id in jit_fns
+            ):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in tainted:
+                return True
+        return False
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not value_tainted(node.value):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                tainted.add(tgt.id)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for el in tgt.elts:
+                    if isinstance(el, ast.Name):
+                        tainted.add(el.id)
+    return tainted
+
+
+def _expr_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+    return False
+
+
+def _scan_loop_body(loop: ast.For, tainted: Set[str], rel: str,
+                    flagged: Set[int], findings: List[Finding]) -> None:
+    for node in ast.walk(loop):
+        if node is loop or not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        hit = None
+        if (
+            isinstance(func, ast.Name)
+            and func.id in _CASTS
+            and node.args
+            and _expr_tainted(node.args[0], tainted)
+        ):
+            hit = f"{func.id}()"
+        elif (
+            isinstance(func, ast.Attribute)
+            and func.attr in _SYNC_ATTRS
+        ):
+            # X.item() / X.block_until_ready() on a tainted receiver, or
+            # jax.block_until_ready(X) with a tainted argument
+            recv_tainted = _expr_tainted(func.value, tainted)
+            arg_tainted = bool(node.args) and _expr_tainted(
+                node.args[0], tainted)
+            if func.attr == "item" and recv_tainted:
+                hit = ".item()"
+            elif func.attr == "block_until_ready" and (
+                recv_tainted or arg_tainted
+            ):
+                hit = "block_until_ready()"
+        elif (
+            isinstance(func, ast.Attribute)
+            and func.attr == "asarray"
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("np", "numpy")
+            and node.args
+            and _expr_tainted(node.args[0], tainted)
+        ):
+            hit = "np.asarray"
+        if hit is None or node.lineno in flagged:
+            continue
+        flagged.add(node.lineno)
+        findings.append(Finding(
+            _RULE, rel, node.lineno,
+            f"{hit} on a jitted step's result inside the training loop "
+            "blocks until every dispatched step retires; accumulate "
+            "device scalars and fetch once per epoch "
+            "(jax.device_get outside the loop)",
+        ))
+
+
+def _scan_train_fn(fn: ast.AST, rel: str,
+                   findings: List[Finding]) -> None:
+    jit_fns = _jit_fn_names(fn)
+    if not jit_fns:
+        return
+    tainted = _tainted_names(fn, jit_fns)
+    if not tainted:
+        return
+    flagged: Set[int] = set()  # nested for-loops would double-report
+    for node in ast.walk(fn):
+        if isinstance(node, ast.For):
+            _scan_loop_body(node, tainted, rel, flagged, findings)
+
+
+def check_train_loop(
+    paths: Iterable[str], repo_root: Optional[str] = None
+) -> List[Finding]:
+    repo_root = repo_root or os.getcwd()
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, repo_root)
+        for node in ast.walk(tree):
+            if _is_train_fn(node):
+                _scan_train_fn(node, rel, findings)
+    return findings

@@ -29,6 +29,19 @@ from mmlspark_tpu.automl.statistics import (
 
 
 def evaluate_scored(df: DataFrame, label_col: str, metric: str) -> float:
+    # raw score frames (TPUModel: a (n, classes) scores column, no label
+    # column) evaluate through their argmax; the wrapped-trainer frames
+    # already carry scored_labels/prediction and are left alone
+    if (
+        M.SCORED_LABELS_COL not in df
+        and M.PREDICTION_COL not in df
+        and M.SCORES_COL in df
+    ):
+        sv = np.asarray(df[M.SCORES_COL])
+        if sv.ndim == 2 and sv.shape[1] >= 2:
+            df = df.with_column(
+                M.SCORED_LABELS_COL, sv.argmax(axis=1).astype(np.int64)
+            )
     stats = ComputeModelStatistics(
         evaluation_metric="all", label_col=label_col
     ).transform(df)

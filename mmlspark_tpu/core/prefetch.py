@@ -3,8 +3,10 @@
 Host staging work (JPEG decode, Parquet chunk reads, bin transforms —
 anything inherently host-side) runs in a worker pool feeding staged host
 payloads; a single pipeline thread uploads each staged payload to device
-HBM — uploads stay SERIALIZED (BASELINE.md round 3: concurrent in-flight
-device_puts collapse tunnel throughput ~50x) — and parks up to `depth`
+HBM — uploads are ISSUED one at a time in order (BASELINE.md round 3:
+unbounded concurrent device_puts collapse tunnel throughput ~50x) with at
+most `depth` transfers unconfirmed in flight, so the producer never waits
+on the consumer's dispatched-compute backlog — and parks up to `depth`
 device-resident payloads in a bounded queue. The consumer drains the queue
 while the next payload stages and uploads behind it, so chunk N+1's h2d
 overlaps chunk N's device compute.
@@ -138,7 +140,7 @@ class _PrefetchState:
     owning) the public prefetcher, so the thread cannot keep an abandoned
     prefetcher alive."""
 
-    def __init__(self, depth: int):
+    def __init__(self, depth: int, ledger_class: str = "prefetch_chunks"):
         self.q: "queue.Queue" = queue.Queue(maxsize=depth)
         self.stop = threading.Event()
         self.error: Optional[BaseException] = None
@@ -152,6 +154,10 @@ class _PrefetchState:
         # still-producing upload is freed immediately
         self.ledger_entries: Dict[int, Any] = {}
         self.ledger_released = False
+        # the resident-byte class (obs/memory.CLASSES) parked chunks are
+        # attributed to: "prefetch_chunks" for generic streaming, or
+        # "train_batches" when the trainer owns the pipeline
+        self.ledger_class = ledger_class
         self.owner = f"prefetch-{id(self)}"
 
 
@@ -172,12 +178,12 @@ def _ledger_add(state: _PrefetchState, idx: int, batch: Any,
     elif isinstance(leaf, (tuple, list)):
         leaf = leaf[0] if leaf else None
     dev = device_label(leaf)
-    led.record_alloc(dev, "prefetch_chunks", nbytes, owner=state.owner)
+    led.record_alloc(dev, state.ledger_class, nbytes, owner=state.owner)
     with state.tl_lock:
         if not state.ledger_released:
             state.ledger_entries[idx] = (dev, nbytes)
             return
-    led.record_free(dev, "prefetch_chunks", nbytes, owner=state.owner)
+    led.record_free(dev, state.ledger_class, nbytes, owner=state.owner)
 
 
 def _ledger_pop(state: _PrefetchState, idx: int) -> None:
@@ -190,7 +196,7 @@ def _ledger_pop(state: _PrefetchState, idx: int) -> None:
     from mmlspark_tpu.obs.memory import memory_ledger
 
     memory_ledger().record_free(
-        entry[0], "prefetch_chunks", entry[1], owner=state.owner)
+        entry[0], state.ledger_class, entry[1], owner=state.owner)
 
 
 def _ledger_release(state: _PrefetchState) -> None:
@@ -208,7 +214,7 @@ def _ledger_release(state: _PrefetchState) -> None:
 
     led = memory_ledger()
     for dev, nbytes in entries:
-        led.record_free(dev, "prefetch_chunks", nbytes, owner=state.owner)
+        led.record_free(dev, state.ledger_class, nbytes, owner=state.owner)
 
 
 def _finalize_state(state: _PrefetchState) -> None:
@@ -245,6 +251,14 @@ def _produce(
             # shard reader may be far larger than host RAM)
             window = workers + 1
             futures: "deque" = deque()
+            # lagged completion barrier: at most `depth` uploads may be
+            # unconfirmed before the producer stops to let the device
+            # drain. Blocking on upload N itself (the old scheme) couples
+            # the producer to the consumer's dispatched-compute backlog —
+            # transfers queue behind executions on the device stream — and
+            # serializes the pipeline into lockstep with the train loop.
+            inflight: "deque" = deque()
+            max_inflight = max(1, state.q.maxsize)
             for _ in range(window):
                 try:
                     futures.append(pool.submit(stage, next(source)))
@@ -265,10 +279,9 @@ def _produce(
                     import jax
 
                     batch = upload_host_chunk(host, tgt)
-                    # block: "upload done" must mean bytes ON the device,
-                    # and serialized uploads are the measured fast path
-                    # for the tunnel-attached chip
-                    jax.block_until_ready(batch)
+                    inflight.append(batch)
+                    if len(inflight) > max_inflight:
+                        jax.block_until_ready(inflight.popleft())
                 else:
                     batch = host
                 upload_done = time.perf_counter()
@@ -326,7 +339,8 @@ def _produce(
 
 class _ChunkPipeline:
     """The shared pipeline core: lazy source -> staged host payloads ->
-    serialized counted uploads -> depth-bounded device queue. Subclasses
+    ordered counted uploads (a depth-bounded in-flight window) ->
+    depth-bounded device queue. Subclasses
     only shape the constructor surface."""
 
     def __init__(
@@ -338,8 +352,9 @@ class _ChunkPipeline:
         upload: bool = True,
         sharding: Any = None,
         placement: Optional[Callable[[Any], Any]] = None,
+        ledger_class: str = "prefetch_chunks",
     ):
-        self._state = _PrefetchState(max(1, int(depth)))
+        self._state = _PrefetchState(max(1, int(depth)), ledger_class)
         self._started = False
         with _STATES_LOCK:
             _LIVE_STATES.add(self._state)
@@ -476,13 +491,18 @@ class DeviceChunkPrefetcher(_ChunkPipeline):
         2 keeps one uploading while one is consumed). This bounds the
         streaming HBM footprint at depth * chunk_bytes, measured by
         `summary()["resident_bytes_peak"]`.
-    workers: staging pool size (stage parallelism; uploads stay serial).
+    workers: staging pool size (stage parallelism; uploads stay ordered,
+        with at most `depth` transfers unconfirmed in flight).
     upload: False yields host payloads instead (stage-only prefetch).
     placement: work unit -> jax Device (or Sharding) — the SHARDED upload
         mode (ISSUE 15): each staged chunk's rows are device_put leaf-wise
         directly onto their owning device (round-robin shard->device
         ownership in the sharded GBDT ingestion path), counted in the same
         dataplane metrics. Overrides `sharding` per item.
+    ledger_class: the device-memory-ledger class (obs/memory.CLASSES)
+        parked chunks are attributed to; the DNN trainer passes
+        "train_batches" so in-flight batch shards are distinguishable
+        from generic streamed chunks in /debug/memory.
 
     Use as an iterator (or context manager for early-exit cleanup):
 
@@ -501,11 +521,12 @@ class DeviceChunkPrefetcher(_ChunkPipeline):
         upload: bool = True,
         sharding: Any = None,
         placement: Optional[Callable[[Any], Any]] = None,
+        ledger_class: str = "prefetch_chunks",
     ):
         super().__init__(
             chunks, stage_fn if stage_fn is not None else (lambda c: c),
             depth=depth, workers=workers, upload=upload, sharding=sharding,
-            placement=placement,
+            placement=placement, ledger_class=ledger_class,
         )
 
 
@@ -521,7 +542,8 @@ class DeviceBatchPrefetcher(_ChunkPipeline):
     batch_size: items per staged batch.
     depth: device batches parked ahead of the consumer (the double buffer;
         2 keeps one uploading while one is consumed).
-    workers: decode pool size (decode parallelism; uploads stay serial).
+    workers: decode pool size (decode parallelism; uploads stay ordered,
+        with at most `depth` transfers unconfirmed in flight).
     upload: False yields host batches instead (decode-only prefetch).
 
     Use as an iterator (or context manager for early-exit cleanup):

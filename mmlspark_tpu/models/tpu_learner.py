@@ -1,4 +1,5 @@
-"""TPULearner — in-process data-parallel deep-net training on a device mesh.
+"""TPULearner — pipelined in-process data-parallel deep-net training on a
+device mesh.
 
 The cntk-train equivalent (reference: CNTKLearner.fit,
 src/cntk-train/src/main/scala/CNTKLearner.scala:102-204). The reference
@@ -7,7 +8,13 @@ VMs and running `mpirun ... cntk` over ssh (CommandBuilders.scala:149-269).
 None of that survives the TPU redesign:
 
 - BrainScript config  -> the Network JSON spec (dnn/network.py)
-- CNTKTextFormat + scp -> host arrays `device_put` straight into HBM
+- CNTKTextFormat + scp -> a pipelined host->HBM input dataplane
+  (core/prefetch.py): a producer slices/shuffles/pads batches on host and
+  uploads each device batch shard through the counted `upload_host_chunk`
+  path while the consumer thread only dequeues device-resident shards and
+  dispatches the jitted step — h2d for batch N+1 overlaps device compute
+  for batch N, measured by the prefetcher's `overlap_ratio`
+  (`prefetch_depth`; 0 restores the synchronous per-step upload loop)
 - mpirun + MPI allreduce -> ONE jit-compiled train step whose batch dim is
   sharded over the mesh "data" axis; XLA inserts the gradient psum over ICI
 - `parallelTrain=true` -> always on; single chip is just a 1-device mesh
@@ -16,16 +23,32 @@ Optionally shards dense-layer kernels over a "model" mesh axis (tensor
 parallelism) — computation follows the argument shardings, so the same step
 function serves dp, dp x tp, and single-chip.
 
+Beyond the reference (docs/dnn-training.md):
+
+- gradient accumulation (``accum_steps``): the global batch splits into
+  fixed-order microbatches whose f32-accumulated gradients make ONE
+  optimizer/BN update (a lax.scan, not a Python loop), so global batches
+  larger than HBM train with run-to-run delta 0.0 at any device count;
+- out-of-core epochs (``fit_from_reader``): trains straight from a
+  ShardReader's bounded chunk passes without materializing the dataset,
+  reshuffling via per-chunk permutations of the same replayable rng the
+  checkpoint store snapshots;
+- stacked AutoML trials (``fit_trials``): N small-model hyperparameter
+  trials vmapped into one program reusing one prefetched batch stream
+  (automl/tune.py ``device_parallelism``).
+
 Determinism contract: global-batch semantics are identical at any device
 count (BatchNorm batch stats and gradient means are global reductions), so
 the 1-device and 8-device loss trajectories match to float tolerance — the
-test-mode guarantee SURVEY.md §4 carries over from local[*].
+test-mode guarantee SURVEY.md §4 carries over from local[*]. The pipelined
+loop changes only WHERE batches are uploaded, never their content or
+order, so pipelined-vs-synchronous trajectories match exactly (delta 0.0).
 """
 
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -46,6 +69,11 @@ from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
 
 LOSSES = ("softmax_cross_entropy", "sigmoid_cross_entropy", "mse")
 
+#: hyperparameters fit_trials may vary per stacked trial — scalars the
+#: vmapped step takes as traced inputs (everything else would change the
+#: program itself)
+TRIAL_PARAMS = ("learning_rate", "momentum", "weight_decay")
+
 
 class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
     """In-process pjit DP(+TP) network trainer; the CNTKLearner role (CNTKLearner.scala) without the outer process."""
@@ -61,7 +89,25 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
     epochs = Param("epochs", "Number of passes over the data", TypeConverters.to_int)
     batch_size = Param(
         "batch_size",
-        "GLOBAL batch size (rounded up to a multiple of the data-axis size)",
+        "GLOBAL batch size (rounded up to a multiple of the data-axis size "
+        "times accum_steps)",
+        TypeConverters.to_int,
+    )
+    accum_steps = Param(
+        "accum_steps",
+        "Gradient-accumulation microbatches per optimizer step (1: off). "
+        "The global batch is split into this many fixed-order microbatches "
+        "whose f32-accumulated gradients make ONE optimizer/BN update, so "
+        "global batches larger than HBM train with identical run-to-run "
+        "results; reduction order differs from the unaccumulated step "
+        "(documented parity tolerance, docs/dnn-training.md)",
+        TypeConverters.to_int,
+    )
+    prefetch_depth = Param(
+        "prefetch_depth",
+        "Device batches staged ahead of the train step by the async input "
+        "pipeline (bounds in-flight HBM at depth x batch bytes; 0 restores "
+        "the synchronous per-step upload loop — the rollback lever)",
         TypeConverters.to_int,
     )
     seed = Param("seed", "PRNG seed for init/shuffle/dropout", TypeConverters.to_int)
@@ -101,6 +147,8 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
             weight_decay=1e-4,
             epochs=10,
             batch_size=32,
+            accum_steps=1,
+            prefetch_depth=2,
             seed=0,
             shuffle=True,
             output_col="scores",
@@ -153,11 +201,14 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
         ]
         return jax.tree_util.tree_unflatten(treedef, shardings)
 
-    def _loss_fn(self, net: Network, loss_kind: str):
+    def _per_example_loss(self, net: Network, loss_kind: str):
+        """params/state/batch -> (per-example f32 loss vector, new state);
+        the shared kernel both the mean step and the accumulation scan
+        normalize over their own weight totals."""
         import jax
         import jax.numpy as jnp
 
-        def compute(params, state, x, y, w, rng):
+        def per_example(params, state, x, y, w, rng):
             variables = {"params": params, "state": state}
             logits, new_state = net.apply_and_state(
                 variables, x, train=True, rng=rng, sample_weight=w
@@ -177,6 +228,17 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
                 per = jnp.mean((logits - yt) ** 2, axis=-1)
             else:
                 raise ValueError(f"unknown loss {loss_kind!r}; one of {LOSSES}")
+            return per, new_state
+
+        return per_example
+
+    def _loss_fn(self, net: Network, loss_kind: str):
+        import jax.numpy as jnp
+
+        per_example = self._per_example_loss(net, loss_kind)
+
+        def compute(params, state, x, y, w, rng):
+            per, new_state = per_example(params, state, x, y, w, rng)
             total_w = jnp.maximum(jnp.sum(w), 1e-9)
             return jnp.sum(per * w) / total_w, new_state
 
@@ -207,22 +269,19 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
         yv = ycol.values
         if yv.dtype == object:
             yv = np.asarray(list(yv), dtype=np.float64)
-        if self.get(self.loss) == "mse":
-            y = yv.astype(np.float32)
-        else:
-            y = np.rint(yv.astype(np.float64)).astype(np.int32)
+        y = self._cast_labels(yv)
         return x, y
+
+    def _cast_labels(self, yv: np.ndarray) -> np.ndarray:
+        if self.get(self.loss) == "mse":
+            return yv.astype(np.float32)
+        return np.rint(yv.astype(np.float64)).astype(np.int32)
 
     # -- checkpoint/resume -----------------------------------------------------
 
-    def _fit_fingerprint(self, x: np.ndarray, y: np.ndarray) -> str:
-        """Identity of (config, data) a checkpoint may resume against —
-        resuming with a different network/optimizer/data would silently
-        train a chimera, so the store refuses it loudly instead."""
-        from mmlspark_tpu.io.checkpoint import fingerprint
-
+    def _config_ident(self) -> Dict[str, Any]:
         net: Network = self.get(self.network)
-        ident = {
+        ident: Dict[str, Any] = {
             "spec": net.spec,
             "input_shape": list(net.input_shape),
             "loss": self.get(self.loss),
@@ -233,10 +292,43 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
             "batch_size": self.get(self.batch_size),
             "seed": self.get(self.seed),
             "shuffle": self.get(self.shuffle),
-            "x_shape": list(x.shape),
-            "y_shape": list(y.shape),
         }
+        # accum_steps joins the fingerprint ONLY when it changes the math
+        # (>1), so every store written before the knob existed — or with
+        # accumulation off — keeps resuming. prefetch_depth never joins:
+        # it changes where batches upload, not what the step computes.
+        if int(self.get(self.accum_steps)) > 1:
+            ident["accum_steps"] = int(self.get(self.accum_steps))
+        return ident
+
+    def _fit_fingerprint(self, x: np.ndarray, y: np.ndarray) -> str:
+        """Identity of (config, data) a checkpoint may resume against —
+        resuming with a different network/optimizer/data would silently
+        train a chimera, so the store refuses it loudly instead."""
+        from mmlspark_tpu.io.checkpoint import fingerprint
+
+        ident = self._config_ident()
+        ident["x_shape"] = list(x.shape)
+        ident["y_shape"] = list(y.shape)
         return fingerprint(ident, x, y)
+
+    def _reader_fingerprint(self, reader, feature_cols: List[str]) -> str:
+        """Streamed-fit identity: the reader's geometry stands in for the
+        data bytes (hashing an out-of-core dataset would defeat the point);
+        chunk_rows is included because it fixes the batch sequence under
+        per-chunk reshuffle."""
+        from mmlspark_tpu.io.checkpoint import fingerprint
+
+        ident = self._config_ident()
+        ident["stream"] = {
+            "format": reader.format,
+            "num_rows": int(reader.num_rows),
+            "num_shards": int(reader.num_shards),
+            "chunk_rows": int(reader.chunk_rows),
+            "feature_cols": list(feature_cols),
+            "label_col": self.get(self.label_col),
+        }
+        return fingerprint(ident)
 
     def _commit_checkpoint(self, store, train_state, key, rng, epoch: int,
                            losses: List[float], fingerprint: str) -> None:
@@ -264,23 +356,179 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
             },
         )
 
+    # -- batch production (host side of the pipeline) --------------------------
+
+    @staticmethod
+    def _pad_batch(bx: np.ndarray, by: np.ndarray, m: int,
+                   bs: int) -> Dict[str, np.ndarray]:
+        """Pad a final partial batch to the fixed step shape with repeated
+        last rows at zero weight — never dropped, never recompiled."""
+        bw = np.ones(m, np.float32)
+        if m < bs:
+            pad = bs - m
+            bx = np.concatenate([bx, np.repeat(bx[-1:], pad, axis=0)])
+            by = np.concatenate([by, np.repeat(by[-1:], pad, axis=0)])
+            bw = np.concatenate([bw, np.zeros(pad, np.float32)])
+        return {"x": bx, "y": by, "w": bw}
+
+    def _memory_batches(self, x: np.ndarray, y: np.ndarray, bs: int,
+                        rng, counts: List[int]) -> Iterator[Dict[str, np.ndarray]]:
+        """One epoch of host batch payloads from in-memory arrays. Appends
+        each batch's true row count to `counts` BEFORE yielding, so the
+        consumer (which sees batches in the same FIFO order) can weight
+        epoch losses without a per-step device sync."""
+        n = x.shape[0]
+        order = rng.permutation(n) if self.get(self.shuffle) else np.arange(n)
+        for s in range(-(-n // bs)):
+            idx = order[s * bs: (s + 1) * bs]
+            if len(idx) == 0:
+                continue
+            counts.append(len(idx))
+            yield self._pad_batch(x[idx], y[idx], len(idx), bs)
+
+    def _stream_batches(self, reader, feature_cols: List[str], label: str,
+                        net: Network, bs: int, rng,
+                        counts: List[int]) -> Iterator[Dict[str, np.ndarray]]:
+        """One epoch of host batch payloads from a ShardReader's bounded
+        chunk pass: at most one chunk plus a sub-batch remainder is ever
+        resident. Epoch reshuffle is per-chunk permutation of the SAME
+        replayable rng the checkpoint store snapshots; with shuffle off the
+        batch sequence equals the in-memory fit's exactly."""
+        shuffle = self.get(self.shuffle)
+        in_shape = tuple(net.input_shape)
+        buf_x: Optional[np.ndarray] = None
+        buf_y: Optional[np.ndarray] = None
+        for chunk in reader.iter_chunks():
+            cx = chunk.matrix(feature_cols, np.float32)
+            if cx.shape[1:] != in_shape:
+                cx = cx.reshape((cx.shape[0],) + in_shape)
+            cy = self._cast_labels(np.asarray(chunk.columns[label]))
+            if shuffle:
+                perm = rng.permutation(chunk.rows)
+                cx, cy = cx[perm], cy[perm]
+            if buf_x is not None:
+                cx = np.concatenate([buf_x, cx])
+                cy = np.concatenate([buf_y, cy])
+                buf_x = buf_y = None
+            pos = 0
+            while cx.shape[0] - pos >= bs:
+                counts.append(bs)
+                yield {
+                    "x": cx[pos:pos + bs],
+                    "y": cy[pos:pos + bs],
+                    "w": np.ones(bs, np.float32),
+                }
+                pos += bs
+            if pos < cx.shape[0]:
+                buf_x, buf_y = cx[pos:].copy(), cy[pos:].copy()
+        if buf_x is not None and len(buf_x):
+            m = len(buf_x)
+            counts.append(m)
+            yield self._pad_batch(buf_x, buf_y, m, bs)
+
+    # -- ledger wiring ---------------------------------------------------------
+
+    def _track_train_state(self, train_state, mesh):
+        """Account the uploaded train state (weights + optimizer + BN) in
+        the device-memory ledger: one full copy resident on every mesh
+        device (TP-sharded dense kernels are a small overcount, same
+        approximation as tpu_model._track_replicated_weights). Returns the
+        release callable fit() invokes when training ends."""
+        import jax
+
+        from mmlspark_tpu.obs.memory import memory_ledger
+        from mmlspark_tpu.utils.profiling import dataplane_counters
+
+        leaves = jax.tree_util.tree_leaves(train_state)
+        nbytes = sum(int(getattr(leaf, "nbytes", 0)) for leaf in leaves)
+        dataplane_counters().record_h2d(nbytes)
+        led = memory_ledger()
+        if not led.enabled or not leaves or nbytes <= 0:
+            return lambda: None
+        devices = list(mesh.devices.flat)
+        owner = "tpu_learner:train_state"
+        led.record_alloc_devices(devices, "model_weights", nbytes, owner=owner)
+
+        def release():
+            led.record_free_devices(
+                devices, "model_weights", nbytes, owner=owner)
+
+        return release
+
     # -- fit -------------------------------------------------------------------
 
     def fit(self, df: DataFrame, checkpoint_dir: Optional[str] = None,
             checkpoint_every: Optional[int] = None) -> TPUModel:
+        x, y = self._extract_xy(df)
+        return self._train(
+            x=x, y=y, reader=None, feature_cols=None,
+            checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+        )
+
+    def fit_from_reader(self, reader,
+                        feature_cols: Optional[Sequence[str]] = None,
+                        checkpoint_dir: Optional[str] = None,
+                        checkpoint_every: Optional[int] = None) -> TPUModel:
+        """Train out-of-core from a ShardReader (io/columnar.py) without
+        ever materializing the dataset: each epoch is one bounded chunk
+        pass whose batches flow through the same pipelined dataplane —
+        host residency stays at one chunk plus a sub-batch remainder.
+
+        `feature_cols` defaults to every reader column except `label_col`.
+        Checkpointing composes exactly as with fit(): the fingerprint binds
+        the reader geometry (rows/shards/chunk_rows/columns) instead of
+        the data bytes."""
+        label = self.get(self.label_col)
+        names = list(reader.column_names)
+        if label not in names:
+            raise ValueError(
+                f"label column {label!r} not in reader columns {names}")
+        cols = (
+            list(feature_cols) if feature_cols is not None
+            else [c for c in names if c != label]
+        )
+        if not cols:
+            raise ValueError("reader has no feature columns")
+        missing = [c for c in cols if c not in names]
+        if missing:
+            raise ValueError(f"feature columns {missing} not in reader")
+        if reader.num_rows is None:
+            raise ValueError(
+                "fit_from_reader needs a reader with known num_rows "
+                "(Parquet footers / npy headers provide it)")
+        return self._train(
+            x=None, y=None, reader=reader, feature_cols=cols,
+            checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+        )
+
+    def _train(self, *, x: Optional[np.ndarray], y: Optional[np.ndarray],
+               reader, feature_cols: Optional[List[str]],
+               checkpoint_dir: Optional[str],
+               checkpoint_every: Optional[int]) -> TPUModel:
         import jax
         import jax.numpy as jnp
 
+        from mmlspark_tpu.core.prefetch import (
+            DeviceChunkPrefetcher,
+            upload_host_chunk,
+        )
+
         log = get_logger("mmlspark_tpu.train")
         net: Network = self.get(self.network)
-        x, y = self._extract_xy(df)
-        n = x.shape[0]
+        streamed = reader is not None
+        n = int(reader.num_rows) if streamed else x.shape[0]
         if n == 0:
-            raise ValueError("cannot fit on an empty DataFrame")
+            raise ValueError("cannot fit on an empty dataset")
+        label = self.get(self.label_col)
 
         mesh = self._make_mesh()
         dp = mesh.shape[DATA_AXIS]
-        bs = -(-self.get(self.batch_size) // dp) * dp
+        accum = max(1, int(self.get(self.accum_steps)))
+        # each of the `accum` microbatches must itself split over the data
+        # axis, so the global batch rounds up to a multiple of dp * accum
+        unit = dp * accum
+        bs = -(-self.get(self.batch_size) // unit) * unit
+        depth = max(0, int(self.get(self.prefetch_depth)))
         rng = np.random.default_rng(self.get(self.seed))
         key = jax.random.PRNGKey(self.get(self.seed))
 
@@ -313,7 +561,10 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
             store = CheckpointStore(
                 ckpt_dir, keep_last=self.get(self.checkpoint_keep_last)
             )
-            fingerprint = self._fit_fingerprint(x, y)
+            fingerprint = (
+                self._reader_fingerprint(reader, feature_cols) if streamed
+                else self._fit_fingerprint(x, y)
+            )
             ck = store.load_latest()
             if ck is not None:
                 if ck.meta.get("fingerprint") != fingerprint:
@@ -357,11 +608,11 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
 
         state_shard = self._param_sharding(mesh, train_state)
         train_state = jax.device_put(train_state, state_shard)
-        x_spec = [DATA_AXIS] + [None] * (x.ndim - 1)
-        x_shard = NamedSharding(mesh, P(*x_spec))
-        y_spec = [DATA_AXIS] + [None] * (y.ndim - 1)
-        y_shard = NamedSharding(mesh, P(*y_spec))
-        w_shard = NamedSharding(mesh, P(DATA_AXIS))
+        release_state = self._track_train_state(train_state, mesh)
+        # ONE leaf-wise sharding serves x, y and w: dim 0 splits over the
+        # data axis, every trailing dim replicates (P of lower rank than
+        # the operand pads with None)
+        batch_shard = NamedSharding(mesh, P(DATA_AXIS))
 
         compute = self._loss_fn(net, self.get(self.loss))
 
@@ -376,28 +627,86 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
             new_params = optax.apply_updates(ts["params"], updates)
             return {"params": new_params, "state": new_state, "opt": new_opt}, loss
 
-        # Donating the train state lets XLA update parameter buffers in
-        # place (the HBM win on real chips). On the multi-replica CPU
-        # backend (the 8-virtual-device test mesh) donation exposes a
-        # read-after-donate race: a replica's collective contribution can
-        # still be reading the donated input while its buffer is reused,
-        # corrupting gradients nondeterministically under scheduler load
-        # (loss trajectories drift 1-16% run to run; reproduced by
-        # test_loss_parity_1_vs_8_devices under concurrent CPU activity,
-        # gone with donation off). Donate only where it is race-free.
-        donate_ok = mesh.size == 1 or jax.default_backend() != "cpu"
-        jit_step = (
-            jax.jit(step, donate_argnums=(0,)) if donate_ok else jax.jit(step)
-        )
+        per_example = self._per_example_loss(net, self.get(self.loss))
 
-        steps_per_epoch = -(-n // bs)  # ceil: the final partial batch is
-        # padded with zero-weight rows, never dropped
+        def accum_step(ts, bx, by, bw, step_key):
+            # Fixed-order lax.scan over `accum` microbatches: per-micro
+            # gradients of the weighted-SUM loss accumulate in f32 and are
+            # normalized by the total weight at the end — the same mean
+            # gradient as the unaccumulated step up to float reduction
+            # order (the documented parity band). BN state threads
+            # sequentially through the scan (micro-batch statistics), and
+            # each micro gets its own dropout key — all deterministic, so
+            # rerun delta is exactly 0.0.
+            import optax
+
+            def micro(a):
+                return a.reshape((accum, a.shape[0] // accum) + a.shape[1:])
+
+            def micro_loss(params, state, mx, my, mw, k):
+                per, new_state = per_example(params, state, mx, my, mw, k)
+                wsum = jnp.sum(mw).astype(jnp.float32)
+                return jnp.sum(per * mw), (new_state, wsum)
+
+            def body(carry, inp):
+                state, gacc, lacc, wacc = carry
+                mx, my, mw, k = inp
+                (lsum, (new_state, wsum)), g = jax.value_and_grad(
+                    micro_loss, has_aux=True
+                )(ts["params"], state, mx, my, mw, k)
+                gacc = jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(jnp.float32), gacc, g)
+                return (new_state, gacc, lacc + lsum.astype(jnp.float32),
+                        wacc + wsum), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), ts["params"])
+            f0 = jnp.zeros((), jnp.float32)
+            (new_state, gsum, lsum, wsum), _ = jax.lax.scan(
+                body, (ts["state"], zeros, f0, f0),
+                (micro(bx), micro(by), micro(bw),
+                 jax.random.split(step_key, accum)),
+            )
+            total_w = jnp.maximum(wsum, 1e-9)
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / total_w).astype(p.dtype), gsum,
+                ts["params"])
+            updates, new_opt = tx.update(grads, ts["opt"], ts["params"])
+            new_params = optax.apply_updates(ts["params"], updates)
+            return ({"params": new_params, "state": new_state,
+                     "opt": new_opt}, lsum / total_w)
+
+        step_fn = step if accum == 1 else accum_step
+
+        # Donation policy (PR 5 -> PR 18). The train state updates in place
+        # on every backend EXCEPT the multi-replica CPU mesh: there a
+        # replica's collective contribution can still be reading the
+        # donated input while its buffer is reused, corrupting gradients
+        # nondeterministically under scheduler load (loss trajectories
+        # drift 1-16% run to run; reproduced by
+        # test_loss_parity_1_vs_8_devices under concurrent CPU activity,
+        # gone with donation off). Batch buffers became donatable in PR 18:
+        # every batch is a prefetcher-owned FRESH upload the trainer
+        # consumes exactly once, so XLA may reuse its bytes as scratch —
+        # but only off-CPU, where the HBM win exists; on CPU donating
+        # host-shaped batches buys nothing and the multi-replica race
+        # applies to them just as it does to the state.
+        donate_state = mesh.size == 1 or jax.default_backend() != "cpu"
+        donate_batches = jax.default_backend() != "cpu"
+        if donate_state and donate_batches:
+            jit_step = jax.jit(step_fn, donate_argnums=(0, 1, 2, 3))
+        elif donate_state:
+            jit_step = jax.jit(step_fn, donate_argnums=(0,))
+        else:
+            jit_step = jax.jit(step_fn)
+
         epochs = self.get(self.epochs)
         # per-epoch device-utilization accounting (obs/profiler.py): the
-        # step loop syncs every loss scalar, so epoch wall is queue+device
-        # time; training FLOPs per example are estimated at 3x the forward
-        # MACs (backward ~2x forward — the standard accounting), with
-        # dnn/network.py's analytic count as the base. No-op when disabled.
+        # epoch-end loss fetch syncs every dispatched step, so epoch wall
+        # is queue+device time; training FLOPs per example are estimated at
+        # 3x the forward MACs (backward ~2x forward — the standard
+        # accounting), with dnn/network.py's analytic count as the base.
+        # No-op when disabled.
         from mmlspark_tpu.obs.profiler import device_profiler
 
         prof = device_profiler()
@@ -405,51 +714,80 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
             str(d) for d in net.input_shape
         )
         fwd_flops = net.flops_per_example() if prof.enabled else 0.0
-        for epoch in range(start_epoch, epochs):
-            t_epoch = time.perf_counter()
-            order = rng.permutation(n) if self.get(self.shuffle) else np.arange(n)
-            epoch_loss = 0.0
-            count = 0
-            for s in range(steps_per_epoch):
-                idx = order[s * bs : (s + 1) * bs]
-                if len(idx) == 0:
-                    continue
-                bx, by = x[idx], y[idx]
-                bw = np.ones(len(idx), np.float32)
-                if len(idx) < bs:  # pad final partial batch with zero weight
-                    pad = bs - len(idx)
-                    bx = np.concatenate([bx, np.repeat(bx[-1:], pad, axis=0)])
-                    by = np.concatenate([by, np.repeat(by[-1:], pad, axis=0)])
-                    bw = np.concatenate([bw, np.zeros(pad, np.float32)])
-                key, sub = jax.random.split(key)
-                train_state, loss = jit_step(
-                    train_state,
-                    jax.device_put(bx, x_shard),
-                    jax.device_put(by, y_shard),
-                    jax.device_put(bw, w_shard),
-                    sub,
+        self._prefetch_summaries: List[Dict[str, float]] = []
+        try:
+            for epoch in range(start_epoch, epochs):
+                t_epoch = time.perf_counter()
+                counts: List[int] = []
+                source = (
+                    self._stream_batches(
+                        reader, feature_cols, label, net, bs, rng, counts)
+                    if streamed
+                    else self._memory_batches(x, y, bs, rng, counts)
                 )
-                epoch_loss += float(loss) * len(idx)
-                count += len(idx)
-            losses.append(epoch_loss / max(1, count))
-            if prof.enabled:
-                prof.record_device_work(
-                    site="tpu_learner.epoch", model=learner_label,
-                    seconds=time.perf_counter() - t_epoch,
-                    flops=3.0 * fwd_flops * count,
-                )
-            log.debug("learner_epoch", epoch=epoch,
-                      loss=round(losses[-1], 5))
-            if store is not None and (
-                (epoch + 1) % max(1, every) == 0 or epoch == epochs - 1
-            ):
-                self._commit_checkpoint(
-                    store, train_state, key, rng, epoch, losses, fingerprint
-                )
+                step_losses: List[Any] = []
+                if depth > 0:
+                    # the pipelined dataplane: the producer thread slices/
+                    # pads on host and uploads each batch's three leaves
+                    # (x, y, w) through the counted upload_host_chunk path
+                    # onto their data-axis shards; this thread only
+                    # dequeues device-resident batches and dispatches —
+                    # h2d for batch N+1 overlaps compute for batch N
+                    pf = DeviceChunkPrefetcher(
+                        source, depth=depth, workers=1,
+                        placement=lambda item: batch_shard,
+                        ledger_class="train_batches",
+                    )
+                    with pf:
+                        for payload in pf:
+                            key, sub = jax.random.split(key)
+                            train_state, loss = jit_step(
+                                train_state, payload["x"], payload["y"],
+                                payload["w"], sub,
+                            )
+                            step_losses.append(loss)
+                    self._prefetch_summaries.append(pf.summary())
+                else:
+                    # synchronous rollback path: same batches, same counted
+                    # uploads, no overlap — prefetch_depth=0 is the lever
+                    # that restores pre-pipeline behavior exactly
+                    for payload in source:
+                        dev = upload_host_chunk(payload, batch_shard)
+                        key, sub = jax.random.split(key)
+                        train_state, loss = jit_step(
+                            train_state, dev["x"], dev["y"], dev["w"], sub)
+                        step_losses.append(loss)
+                # ONE host sync per epoch: every step's loss scalar fetched
+                # together, weighted by the host-known true row counts —
+                # the per-step float(loss) this replaces serialized async
+                # dispatch (graftcheck per-step-host-sync-in-train-loop)
+                vals = jax.device_get(step_losses)
+                count = sum(counts)
+                epoch_loss = sum(
+                    float(v) * c for v, c in zip(vals, counts))
+                losses.append(epoch_loss / max(1, count))
+                if prof.enabled:
+                    prof.record_device_work(
+                        site="tpu_learner.epoch", model=learner_label,
+                        seconds=time.perf_counter() - t_epoch,
+                        flops=3.0 * fwd_flops * count,
+                    )
+                log.debug("learner_epoch", epoch=epoch,
+                          loss=round(losses[-1], 5))
+                if store is not None and (
+                    (epoch + 1) % max(1, every) == 0 or epoch == epochs - 1
+                ):
+                    self._commit_checkpoint(
+                        store, train_state, key, rng, epoch, losses,
+                        fingerprint
+                    )
 
-        final = jax.device_get(
-            {"params": train_state["params"], "state": train_state["state"]}
-        )
+            final = jax.device_get(
+                {"params": train_state["params"],
+                 "state": train_state["state"]}
+            )
+        finally:
+            release_state()
         bundle = NetworkBundle(net, final)
         model = TPUModel(
             bundle,
@@ -458,6 +796,172 @@ class TPULearner(Estimator, Wrappable, HasFeaturesCol, HasLabelCol):
         )
         model._loss_history = losses
         return model
+
+    # -- stacked AutoML trials -------------------------------------------------
+
+    def fit_trials(self, df: DataFrame,
+                   trial_params: List[Dict[str, float]]) -> List[TPUModel]:
+        """Train N hyperparameter trials of THIS learner as ONE vmapped
+        program sharing one prefetched batch stream — the device-parallel
+        sweep automl/tune.py's `device_parallelism` mode dispatches to.
+
+        Each trial dict may override only the scalar hyperparameters in
+        TRIAL_PARAMS (learning_rate / momentum / weight_decay): those ride
+        the program as traced per-trial inputs, so N trials cost one
+        compile and one batch upload per step instead of N thread-
+        serialized fits. The optimizer update is hand-rolled (optax state
+        is not vmappable over traced hyperparameters) but matches optax's
+        sgd/momentum/adam/adamw trace element-for-element. Trials share
+        init, shuffle order and dropout keys; differences come ONLY from
+        the hyperparameters — exactly what a sweep wants to isolate."""
+        import jax
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.core.prefetch import DeviceChunkPrefetcher
+
+        if not trial_params:
+            raise ValueError("trial_params must name at least one trial")
+        for tp in trial_params:
+            bad = sorted(set(tp) - set(TRIAL_PARAMS))
+            if bad:
+                raise ValueError(
+                    f"fit_trials can only vary {TRIAL_PARAMS}; got {bad}")
+        net: Network = self.get(self.network)
+        x, y = self._extract_xy(df)
+        n = x.shape[0]
+        if n == 0:
+            raise ValueError("cannot fit on an empty DataFrame")
+        t_count = len(trial_params)
+        kind = self.get(self.optimizer)
+        if kind not in ("sgd", "momentum", "adam", "adamw"):
+            raise ValueError(f"unknown optimizer {kind!r}")
+        hyper = {
+            "lr": jnp.asarray([
+                float(tp.get("learning_rate", self.get(self.learning_rate)))
+                for tp in trial_params], jnp.float32),
+            "mu": jnp.asarray([
+                float(tp.get("momentum", self.get(self.momentum)))
+                for tp in trial_params], jnp.float32),
+            "wd": jnp.asarray([
+                float(tp.get("weight_decay", self.get(self.weight_decay)))
+                for tp in trial_params], jnp.float32),
+        }
+
+        bs = min(self.get(self.batch_size), n)
+        rng = np.random.default_rng(self.get(self.seed))
+        key = jax.random.PRNGKey(self.get(self.seed))
+        variables = net.init(key)
+
+        def stack(tree):
+            # identical init for every trial: broadcast one copy along the
+            # new leading trial axis (hyperparameters are the ONLY per-
+            # trial difference)
+            return jax.tree_util.tree_map(
+                lambda p: jnp.broadcast_to(
+                    p, (t_count,) + p.shape).astype(p.dtype),
+                tree,
+            )
+
+        params0 = variables["params"]
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params0)
+        if kind in ("adam", "adamw"):
+            opt0 = {"m": zeros, "v": zeros, "t": jnp.zeros((), jnp.float32)}
+        elif kind == "momentum":
+            opt0 = {"v": zeros}
+        else:
+            opt0 = {}
+        ts = {
+            "params": stack(params0),
+            "state": stack(variables["state"]),
+            "opt": stack(opt0),
+        }
+
+        compute = self._loss_fn(net, self.get(self.loss))
+
+        def apply_update(params, grads, opt, h):
+            # optax-equivalent traces with traced hyperparameters
+            if kind == "sgd":
+                new = jax.tree_util.tree_map(
+                    lambda p, g: p - h["lr"] * g, params, grads)
+                return new, opt
+            if kind == "momentum":
+                v = jax.tree_util.tree_map(
+                    lambda vv, g: h["mu"] * vv + g, opt["v"], grads)
+                new = jax.tree_util.tree_map(
+                    lambda p, vv: p - h["lr"] * vv, params, v)
+                return new, {"v": v}
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            t = opt["t"] + 1.0
+            m = jax.tree_util.tree_map(
+                lambda mm, g: b1 * mm + (1.0 - b1) * g, opt["m"], grads)
+            v = jax.tree_util.tree_map(
+                lambda vv, g: b2 * vv + (1.0 - b2) * g * g, opt["v"], grads)
+            c1 = 1.0 - b1 ** t
+            c2 = 1.0 - b2 ** t
+
+            def upd(p, mm, vv):
+                u = (mm / c1) / (jnp.sqrt(vv / c2) + eps)
+                if kind == "adamw":
+                    u = u + h["wd"] * p
+                return p - h["lr"] * u
+
+            new = jax.tree_util.tree_map(upd, params, m, v)
+            return new, {"m": m, "v": v, "t": t}
+
+        def step_t(one, h, bx, by, bw, step_key):
+            def lf(params):
+                return compute(
+                    params, one["state"], bx, by, bw, step_key)
+
+            (loss, new_state), grads = jax.value_and_grad(
+                lf, has_aux=True)(one["params"])
+            new_params, new_opt = apply_update(
+                one["params"], grads, one["opt"], h)
+            return ({"params": new_params, "state": new_state,
+                     "opt": new_opt}, loss)
+
+        jit_step = jax.jit(jax.vmap(
+            step_t, in_axes=(0, 0, None, None, None, None)))
+
+        depth = max(0, int(self.get(self.prefetch_depth)))
+        epochs = self.get(self.epochs)
+        histories = [[] for _ in range(t_count)]
+        for _epoch in range(epochs):
+            counts: List[int] = []
+            source = self._memory_batches(x, y, bs, rng, counts)
+            step_losses: List[Any] = []
+            pf = DeviceChunkPrefetcher(
+                source, depth=max(1, depth), workers=1,
+                ledger_class="train_batches",
+            )
+            with pf:
+                for payload in pf:
+                    key, sub = jax.random.split(key)
+                    ts, loss_vec = jit_step(
+                        ts, hyper, payload["x"], payload["y"],
+                        payload["w"], sub,
+                    )
+                    step_losses.append(loss_vec)
+            mat = np.asarray(jax.device_get(step_losses))  # (steps, trials)
+            weights = np.asarray(counts, np.float64)[:, None]
+            per_trial = (mat * weights).sum(axis=0) / max(1.0, weights.sum())
+            for t in range(t_count):
+                histories[t].append(float(per_trial[t]))
+
+        host = jax.device_get({"params": ts["params"], "state": ts["state"]})
+        models: List[TPUModel] = []
+        for t in range(t_count):
+            final = jax.tree_util.tree_map(
+                lambda a, _t=t: np.asarray(a[_t]), host)
+            bundle = NetworkBundle(net, final)
+            model = TPUModel(
+                bundle,
+                input_col=self.get(self.features_col),
+                output_col=self.get(self.output_col),
+            )
+            model._loss_history = histories[t]
+            models.append(model)
+        return models
 
     def transform_schema(self, schema: List[Field]) -> List[Field]:
         return schema + [Field(self.get(self.output_col), DataType.VECTOR)]

@@ -10,7 +10,7 @@ training) start from. This module is that accounting:
   framework allocation/free of device-resident bytes reports
   ``record_alloc`` / ``record_free`` with a device, a **class** (one of
   ``CLASSES``: model_weights, dispatch_programs, data_shards,
-  prefetch_chunks, scratch) and an optional owner tag. Gauges:
+  prefetch_chunks, train_batches, scratch) and an optional owner tag. Gauges:
   ``device_resident_bytes{device,class}`` (live),
   ``device_resident_bytes_peak{device,class}`` (high-watermark) and
   ``device_memory_pressure{device}`` (total resident / the per-kind HBM
@@ -81,6 +81,7 @@ CLASSES = (
     "dispatch_programs",
     "data_shards",
     "prefetch_chunks",
+    "train_batches",
     "scratch",
 )
 

@@ -1,0 +1,41 @@
+"""Fixture for the per-step-host-sync-in-train-loop rule: host syncs on a
+jitted step's result inside a fit/train epoch loop. Parsed, never imported."""
+
+import jax
+import numpy as np
+
+
+class BadTrainer:
+    def fit(self, batches):
+        step = jax.jit(lambda s, b: (s, s["loss"]))
+        state = {"loss": 0.0}
+        history = []
+        for batch in batches:
+            state, loss = step(state, batch)
+            history.append(float(loss))  # expect[per-step-host-sync-in-train-loop]
+            val = loss.item()  # expect[per-step-host-sync-in-train-loop]
+            arr = np.asarray(loss)  # expect[per-step-host-sync-in-train-loop]
+            loss.block_until_ready()  # expect[per-step-host-sync-in-train-loop]
+            jax.block_until_ready(state)  # expect[per-step-host-sync-in-train-loop]
+            alias = loss
+            also = float(alias)  # expect[per-step-host-sync-in-train-loop]
+            debug = float(loss)  # graftcheck: ignore[per-step-host-sync-in-train-loop]  # expect-suppressed[per-step-host-sync-in-train-loop]
+            fine = float(batch["rows"])  # host value: clean
+        # outside the loop: the accumulate-then-fetch idiom is the fix
+        vals = jax.device_get(history)
+        return state, vals, val, arr, also, debug, fine
+
+    def _train(self, batches):
+        jit_step = jax.jit(lambda s: s)
+        state = 0
+        for _ in batches:
+            state = jit_step(state)
+        # epoch-end fetch outside the for body: clean
+        return float(state)
+
+    def score(self, batches):
+        # not a fit*/train* function: per-step syncs here are out of scope
+        step = jax.jit(lambda b: b)
+        for batch in batches:
+            out = float(step(batch))
+        return out

@@ -755,6 +755,68 @@ def test_untracked_upload_package_scan_clean():
     assert findings == [], [str(f) for f in findings]
 
 
+# -- per-step host sync in train loop ------------------------------------------
+
+
+def test_train_loop_sync_fires_and_suppresses():
+    from mmlspark_tpu.analysis.train_loop import check_train_loop
+
+    path = os.path.join(FIXTURES, "train_sync_bad.py")
+    findings = check_train_loop([path], repo_root=FIXTURES)
+    _assert_matches_markers("train_sync_bad.py", findings)
+
+
+def test_train_loop_rule_ignores_epoch_end_fetch_and_other_functions():
+    """The accumulate-then-fetch idiom (device_get after the loop) and
+    per-step syncs in functions outside fit*/train* must stay silent."""
+    from mmlspark_tpu.analysis.train_loop import check_train_loop
+
+    path = os.path.join(FIXTURES, "train_sync_bad.py")
+    findings = check_train_loop([path], repo_root=FIXTURES)
+    with open(path) as f:
+        fit_end = next(
+            i for i, line in enumerate(f, start=1)
+            if "def _train" in line
+        )
+    assert findings and all(f.line < fit_end for f in findings), findings
+
+
+def test_train_loop_rule_scoped_to_training_tiers(tmp_path):
+    """run_all only feeds models/ and automl/ to the rule: the same
+    per-step float() in, say, serving/ is another tier's business."""
+    pkg = tmp_path / "mmlspark_tpu"
+    bad_src = (
+        "import jax\n\n"
+        "def fit(batches):\n"
+        "    step = jax.jit(lambda b: b)\n"
+        "    for b in batches:\n"
+        "        out = step(b)\n"
+        "        val = float(out)\n"
+        "    return val\n"
+    )
+    for sub in ("models", "automl", "serving"):
+        d = pkg / sub
+        d.mkdir(parents=True)
+        (d / "__init__.py").write_text("")
+        (d / "mod.py").write_text(bad_src)
+    (pkg / "__init__.py").write_text("")
+    findings = run_all(
+        root=str(tmp_path), select=["per-step-host-sync-in-train-loop"]
+    )
+    paths = {f.path for f in findings}
+    assert os.path.join("mmlspark_tpu", "models", "mod.py") in paths
+    assert os.path.join("mmlspark_tpu", "automl", "mod.py") in paths
+    assert not any("serving" in p for p in paths), paths
+
+
+def test_train_loop_package_scan_clean():
+    """PR 18 satellite: the training tiers carry no per-step host sync —
+    the learner's epoch loop appends device scalars and device_gets them
+    once per epoch."""
+    findings = run_all(root=REPO, select=["per-step-host-sync-in-train-loop"])
+    assert findings == [], [str(f) for f in findings]
+
+
 # -- hardcoded device index ----------------------------------------------------
 
 
