@@ -48,6 +48,8 @@ RULES: Dict[str, str] = {
     "untracked-device-upload": "jax.device_put/jnp.asarray(device=) upload in a dataplane module whose scope shows no counting evidence (upload_host_chunk/record_h2d/memory_ledger); invisible H2D bytes are what make /debug/memory reconciliation drift",
     # train-loop family (train_loop.py)
     "per-step-host-sync-in-train-loop": "float()/.item()/np.asarray()/block_until_ready() on a jitted step's result inside a fit*/train* for-loop serializes async dispatch; accumulate device scalars and device_get once per epoch",
+    # kernel-fallback family (kernel_fallback.py)
+    "kernel-without-fallback": "pallas_call whose enclosing function shows no interpret= path, no interpret parameter, and no *_impl/einsum dispatch arm; the kernel is TPU-only, untested by tier-1 CPU CI, and has no rollback lever",
     # Params-contract family (params_contract.py)
     "param-converter": "simple Param declared without an explicit type converter",
     "param-doc": "stage or Param missing documentation",

@@ -1,0 +1,113 @@
+"""Kernel-fallback rule: every Pallas kernel site must show a rollback arm.
+
+`kernel-without-fallback` flags a ``pl.pallas_call`` (or bare
+``pallas_call``) call site whose enclosing function shows none of the
+fallback evidence the compute-tier contract requires (docs/gbdt.md
+"Pallas compute tier"):
+
+- an ``interpret=`` keyword on the pallas_call itself — the CPU interpret
+  path tier-1 CI runs the kernel body through;
+- an ``interpret`` parameter in the enclosing function's signature — the
+  caller owns the interpret pick and threads it down;
+- a dispatch branch whose test references an ``interpret`` name or an
+  ``*impl``-named pick (``hist_impl``, ``split_impl``, ...) — the
+  kernelized arm sits beside a selectable reference arm;
+- an ``einsum`` call in the same function — the reference contraction is
+  co-located.
+
+A kernel with none of these is TPU-only and un-rollback-able: tier-1 CPU
+CI never executes its body, and a miscompile in production has no
+``hist_impl="einsum"``-style lever. Genuinely TPU-only code (none exists
+today) takes a justified ``# graftcheck: ignore[kernel-without-fallback]``.
+
+Evidence is intentionally checked on the ENCLOSING function only: a
+fallback three frames up the call stack is invisible to the reader of the
+kernel site, which is exactly the drift this rule exists to stop.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from mmlspark_tpu.analysis.base import Finding
+
+_RULE = "kernel-without-fallback"
+
+
+def _is_pallas_call(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr == "pallas_call"
+    return isinstance(func, ast.Name) and func.id == "pallas_call"
+
+
+def _dispatch_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _has_fallback_evidence(fn: ast.AST, call: ast.Call) -> bool:
+    # 1. the pallas_call itself takes interpret= (CPU interpret path)
+    if any(kw.arg == "interpret" for kw in call.keywords):
+        return True
+    # 2. the enclosing function accepts an interpret parameter
+    args = fn.args
+    param_names = {
+        a.arg
+        for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)
+    }
+    if "interpret" in param_names:
+        return True
+    for node in ast.walk(fn):
+        # 3. dispatch branch on an impl pick or interpret flag
+        if isinstance(node, (ast.If, ast.IfExp)):
+            for sub in ast.walk(node.test):
+                name = _dispatch_name(sub)
+                if name and (name.endswith("impl") or name == "interpret"):
+                    return True
+        # 4. co-located einsum reference arm
+        if isinstance(node, ast.Call) and _dispatch_name(node.func) == "einsum":
+            return True
+    return False
+
+
+def _scan_file(tree: ast.AST, rel: str, findings: List[Finding]) -> None:
+    # innermost-enclosing-function map for every pallas_call site
+    def visit(node: ast.AST, fn: Optional[ast.AST]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = node
+        for child in ast.iter_child_nodes(node):
+            visit(child, fn)
+        if isinstance(node, ast.Call) and _is_pallas_call(node):
+            if fn is None or not _has_fallback_evidence(fn, node):
+                where = f"in {fn.name}()" if fn is not None else "at module scope"
+                findings.append(Finding(
+                    _RULE, rel, node.lineno,
+                    f"pallas_call {where} shows no fallback arm: pass "
+                    "interpret=, accept an interpret parameter, or dispatch "
+                    "on an *_impl pick beside an einsum/reference branch "
+                    "(docs/gbdt.md \"Pallas compute tier\")",
+                ))
+
+    visit(tree, None)
+
+
+def check_kernel_fallback(
+    paths: List[str], repo_root: Optional[str] = None
+) -> List[Finding]:
+    repo_root = repo_root or os.getcwd()
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        _scan_file(tree, os.path.relpath(path, repo_root), findings)
+    return findings

@@ -224,6 +224,23 @@ def run_all(
             ],
             repo_root=root,
         )
+    if "kernel-without-fallback" in enabled:
+        from mmlspark_tpu.analysis.kernel_fallback import check_kernel_fallback
+
+        # scoped to the kernel tier: the two modules that own pallas_call
+        # sites (ISSUE 19 compute tier) — every kernel there must keep its
+        # interpret/einsum rollback arm visible at the call site
+        kernel_files = {
+            os.path.join(package_name, "gbdt", "compute.py"),
+            os.path.join(package_name, "dnn", "quant.py"),
+        }
+        findings += check_kernel_fallback(
+            [
+                p for p in package_files
+                if os.path.relpath(p, root) in kernel_files
+            ],
+            repo_root=root,
+        )
     if "unstructured-log-in-library" in enabled:
         from mmlspark_tpu.analysis.unstructured_log import (
             check_unstructured_log,

@@ -68,6 +68,13 @@ def _dense():
     def apply(params, state, cfg, x, train, rng, w=None):
         if x.ndim > 2:
             x = x.reshape(x.shape[0], -1)
+        if "kernel_scale" in params:
+            # int8 weight-only variant (dnn/quant.py): codes stay int8 in
+            # HBM, the Pallas kernel dequantizes in VMEM mid-matmul
+            from mmlspark_tpu.dnn.quant import int8_matmul
+
+            y = int8_matmul(x, params["kernel"], params["kernel_scale"])
+            return y + params["bias"].astype(y.dtype), state
         return x @ params["kernel"] + params["bias"], state
 
     return init, apply
@@ -96,11 +103,19 @@ def _conv():
 
     def apply(params, state, cfg, x, train, rng, w=None):
         import jax
+        import jax.numpy as jnp
 
+        kernel = params["kernel"]
+        if "kernel_scale" in params:
+            # int8 storage-only conv (dnn/quant.py): codes are int8 at
+            # rest; one whole-kernel dequantize feeds the f32 conv (XLA
+            # has no mixed int8/f32 conv — the payload saving is in HBM
+            # residency and the upload, not the MACs)
+            kernel = kernel.astype(jnp.float32) * params["kernel_scale"]
         stride = cfg.get("stride", 1)
         y = jax.lax.conv_general_dilated(
             x,
-            params["kernel"].astype(x.dtype),
+            kernel.astype(x.dtype),
             window_strides=(stride, stride),
             padding=cfg.get("padding", "SAME"),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
@@ -506,6 +521,12 @@ class Network:
     def _cast_in(self, x):
         import jax.numpy as jnp
 
+        if self.compute_dtype == "int8":
+            # int8 is a WEIGHT storage dtype, not an activation dtype:
+            # activations run float32 and only the resident kernels are
+            # quantized (dnn/quant.py — weight-only scheme, no activation
+            # calibration)
+            return x.astype(jnp.float32)
         return x.astype(jnp.dtype(self.compute_dtype))
 
     def apply(self, variables, x, train: bool = False, rng=None):

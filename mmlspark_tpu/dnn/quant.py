@@ -1,0 +1,192 @@
+"""Weight-only int8 quantization for zoo inference (docs/dataplane.md
+"int8 inference variants").
+
+Per-channel symmetric quantization: each OUTPUT channel c of a kernel
+stores int8 codes q[..., c] = round(w[..., c] / scale[c]) with its own f32
+scale[c] = max|w[..., c]| / 127 — 4x smaller weight payload than f32 in
+HBM and on the wire, at ~0.4% worst-case relative weight error. Compute
+stays float32: activations are NEVER quantized (a weight-only scheme needs
+no calibration data and no activation-range tracking), and the matmul
+dequantizes on the fly — ``(x @ q_f32) * scale``, exact in the scale step
+because the per-column factor multiplies AFTER the accumulation.
+
+The dense path runs ``int8_matmul`` below: one Pallas TPU kernel per row
+block that converts the resident int8 codes to f32 **in VMEM** (HBM only
+ever sees the int8 bytes — the 4x traffic saving is the point), runs the
+f32 MXU dot, and scales columns in-register. Off-TPU the kernel body runs
+in Pallas interpret mode — the same arithmetic as plain JAX ops — which is
+how tier-1 CPU CI exercises it. Oversized operands fall back to the XLA
+einsum contraction with the SAME ``(x @ q) * scale`` factorization, so the
+two paths agree to f32 ulps (accumulation order is the only difference).
+Conv kernels take the storage-only scheme: int8 in HBM, one whole-kernel
+dequantize before ``conv_general_dilated`` (XLA has no mixed int8/f32
+conv; the weight payload saving still applies).
+
+Parity is gated, not assumed: ``INT8_LOGIT_MAE_TOL`` in zoo_builders plus
+exact top-1 agreement, mirroring the bf16 gate.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+__all__ = [
+    "quantize_per_channel",
+    "dequantize",
+    "int8_matmul",
+    "quantize_variables",
+]
+
+#: row block per Pallas grid step (f32 sublane-tile friendly; large enough
+#: to keep the MXU busy at zoo batch sizes)
+_MM_BLK_M = 256
+#: fall back to the XLA path when the dequantized weight block would not
+#: comfortably fit VMEM beside the row block (elements of the padded
+#: (K_pad, N_pad) operand; 4 MiB of f32 leaves headroom in ~16 MiB VMEM)
+_MM_VMEM_ELEMS = 1 << 20
+
+
+def quantize_per_channel(w: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-output-channel int8 codes for a kernel.
+
+    The LAST axis is the output channel — true for both dense (d_in, d_out)
+    and conv HWIO (kh, kw, c_in, c_out) kernels. Returns (q int8 same
+    shape, scale f32 (c_out,)); all-zero channels get scale 1.0 so
+    dequantization is exact for them too."""
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=tuple(range(w.ndim - 1)))
+    scale = (amax / 127.0).astype(np.float32)
+    scale = np.where(scale > 0, scale, np.float32(1.0)).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def dequantize(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """f32 weights back from per-channel codes (the reference arm of the
+    parity tests; also the conv storage-only path)."""
+    return np.asarray(q, np.float32) * np.asarray(scale, np.float32)
+
+
+def _interpret_default() -> bool:
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+#: jitted int8_matmul impls keyed by the static interpret flag (jax stays
+#: a lazy import — this module loads without initializing a backend)
+_MM_JIT: Dict[bool, Any] = {}
+
+
+def int8_matmul(x, q, scale, *, interpret=None):
+    """``(x @ q) * scale`` with int8-resident weights: x (n, K) f32,
+    q (K, N) int8, scale (N,) f32 -> (n, N) f32.
+
+    Dispatches between the Pallas dequant-in-VMEM kernel and the XLA
+    contraction fallback (same factorization) on operand size; both paths
+    keep the weights int8 at rest and differ only in f32 accumulation
+    order (documented ulp band, gated by the interpret parity tests)."""
+    import jax
+
+    if interpret is None:
+        interpret = _interpret_default()
+    key = bool(interpret)
+    fn = _MM_JIT.get(key)
+    if fn is None:
+        fn = _MM_JIT[key] = jax.jit(
+            functools.partial(_int8_matmul_impl, interpret=key)
+        )
+    return fn(x, q, scale)
+
+
+def _int8_matmul_impl(x, q, scale, *, interpret: bool):
+    import jax.numpy as jnp
+
+    n, K = x.shape
+    Kq, N = q.shape
+    assert K == Kq, f"x K={K} != q K={Kq}"
+    K_pad = -(-K // 128) * 128
+    N_pad = -(-N // 128) * 128
+    if K_pad * N_pad > _MM_VMEM_ELEMS:
+        # einsum fallback: whole-operand contraction, scale after the dot
+        return (
+            x @ q.astype(jnp.float32)
+        ) * scale.astype(jnp.float32)[None, :]
+    return _int8_matmul_pallas(
+        x, q, scale, n=n, K=K, N=N, K_pad=K_pad, N_pad=N_pad,
+        interpret=bool(interpret),
+    )
+
+
+def _int8_matmul_pallas(x, q, scale, *, n, K, N, K_pad, N_pad, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BLK = _MM_BLK_M
+    n_pad = -(-n // BLK) * BLK
+    xp = jnp.pad(x.astype(jnp.float32), ((0, n_pad - n), (0, K_pad - K)))
+    qp = jnp.pad(q.astype(jnp.int8), ((0, K_pad - K), (0, N_pad - N)))
+    sp = jnp.pad(scale.astype(jnp.float32), (0, N_pad - N))[None, :]
+
+    def kernel(x_ref, q_ref, s_ref, o_ref):
+        # int8 HBM bytes become f32 only here, in VMEM
+        qf = q_ref[:].astype(jnp.float32)            # (K_pad, N_pad)
+        acc = jax.lax.dot_general(
+            x_ref[:], qf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                            # (BLK, N_pad)
+        o_ref[:] = acc * s_ref[:]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // BLK,),
+        in_specs=[
+            pl.BlockSpec((BLK, K_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((K_pad, N_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((BLK, N_pad), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_pad, N_pad), jnp.float32),
+        interpret=interpret,
+    )(xp, qp, sp)
+    return out[:n, :N]
+
+
+def quantize_variables(variables: Dict[str, Any]) -> Dict[str, Any]:
+    """The int8 twin of a variables tree: every layer params dict holding a
+    float ``kernel`` gets int8 codes plus a ``kernel_scale`` leaf; biases,
+    BN leaves, and all state stay float32 (they are O(channels), not
+    O(channels^2) — quantizing them saves nothing and costs accuracy).
+    The presence of ``kernel_scale`` is what the layer apply fns dispatch
+    on (dnn/network.py)."""
+
+    def walk(tree):
+        out = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out[k] = walk(v)
+            else:
+                out[k] = v
+        if (
+            "kernel" in out
+            and not isinstance(out["kernel"], dict)
+            and np.asarray(out["kernel"]).dtype.kind == "f"
+        ):
+            q, scale = quantize_per_channel(np.asarray(out["kernel"]))
+            out["kernel"] = q
+            out["kernel_scale"] = scale
+        return out
+
+    return {
+        "params": walk(variables.get("params", {})),
+        "state": variables.get("state", {}),
+    }

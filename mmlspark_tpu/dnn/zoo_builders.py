@@ -40,6 +40,16 @@ from mmlspark_tpu.dnn.resnet import resnet50
 #: zoo ResNet-50's un-adapted BN leaves logits at O(1e4)).
 BF16_LOGIT_MAE_TOL = 5e-2
 
+#: Documented int8-vs-f32 parity tolerance, same RELATIVE mean-absolute
+#: logit error measure as the bf16 gate (and the same exact-top-1
+#: requirement). Per-channel symmetric weight codes carry <= scale/2 =
+#: max|w_c|/254 absolute error per weight (~0.4% of the channel's peak);
+#: activations stay f32, so the only drift is quantization noise
+#: compounded across depth. 1e-1 bounds that for a ResNet-50 while still
+#: catching real bugs (a lost scale factor or a wrong channel axis throws
+#: logits off by orders of magnitude, not percent).
+INT8_LOGIT_MAE_TOL = 1e-1
+
 
 def resnet50_random(
     num_classes: int = 1000,
@@ -58,6 +68,13 @@ def resnet50_random(
     MANIFEST sha256 is dtype-independent), bf16 compute.
     """
     net = resnet50(num_classes=num_classes, input_shape=tuple(input_shape))
+    if dtype == "int8":
+        # quantized twin of the f32 recipe: same deterministic draw, then
+        # per-channel weight codes (the MANIFEST pins the f32 recipe;
+        # int8 is derived, like bf16 is)
+        return int8_variant(
+            NetworkBundle(net, deterministic_variables(net, seed))
+        )
     if dtype != net.compute_dtype:
         net = Network(net.spec, net.input_shape, dtype)
     return NetworkBundle(net, deterministic_variables(net, seed))
@@ -75,4 +92,25 @@ def bf16_variant(bundle: NetworkBundle) -> NetworkBundle:
         return bundle
     return NetworkBundle(
         Network(net.spec, net.input_shape, "bfloat16"), bundle.variables
+    )
+
+
+def int8_variant(bundle: NetworkBundle) -> NetworkBundle:
+    """The int8 weight-only inference twin of an existing bundle: every
+    kernel leaf becomes per-channel int8 codes + a ``kernel_scale`` row
+    (dnn/quant.py), compute stays float32 (activations are never
+    quantized). Unlike `bf16_variant` the VARIABLES differ too, so the
+    twin holds — and uploads — its own quantized tree (a quarter of the
+    f32 kernel bytes). Parity vs the f32 parent is gated at
+    `INT8_LOGIT_MAE_TOL` relative logit MAE with exact top-1 agreement,
+    mirroring the bf16 gate; stages that only need cheaper MACs (not a
+    smaller resident model) should prefer `bf16_variant`."""
+    from mmlspark_tpu.dnn.quant import quantize_variables
+
+    net = bundle.network
+    if net.compute_dtype == "int8":
+        return bundle
+    return NetworkBundle(
+        Network(net.spec, net.input_shape, "int8"),
+        quantize_variables(bundle.variables),
     )

@@ -143,7 +143,7 @@ class Booster:
         self._packed = dict(
             feats=feats, thr=thr, is_cat=is_cat, cat_mask=cat_mask,
             lefts=lefts, rights=rights, is_leaf=is_leaf, values=values,
-            max_depth=max_depth,
+            max_depth=max_depth, has_cat=bool(is_cat.any()),
         )
         return self._packed
 
@@ -157,6 +157,14 @@ class Booster:
     # event instead of a garbage model score.
     _WALK_CHUNK = 131072
     _VERIFY_ROWS = 64
+    # ensemble-traversal implementation: "auto" takes the fused Pallas
+    # scoring kernel on a TPU backend for all-numeric ensembles, the
+    # reference jit walk otherwise; "pallas" forces the kernel (interpret
+    # mode off-TPU — how tier-1 CPU exercises the kernel body); "raw" is
+    # the rollback lever. Bit-identical either way: the kernel is the same
+    # gather, reformulated as one-hot MXU matmuls (docs/gbdt.md "Pallas
+    # compute tier"), and the sampled host cross-check below guards both.
+    _walk_impl = "auto"
 
     def _packed_device(self):
         """The packed ensemble as device-resident arrays, uploaded once per
@@ -192,11 +200,23 @@ class Booster:
         return self._packed_dev
 
     def _walk_device(self, x):
-        """One chunk through the jit tree walk; returns the device result
-        (callers decide if/when to fetch)."""
-        from mmlspark_tpu.gbdt.compute import walk_trees_raw
+        """One chunk through the device tree walk; returns the device
+        result (callers decide if/when to fetch). Dispatches per
+        `_walk_impl`: categorical ensembles always keep the reference walk
+        (the kernel's packed table is numeric-only)."""
+        from mmlspark_tpu.gbdt.compute import walk_trees_pallas, walk_trees_raw
 
         dev = self._packed_device()
+        impl = self._walk_impl
+        if impl == "auto":
+            import jax
+
+            impl = "pallas" if jax.default_backend() == "tpu" else "raw"
+        if impl == "pallas" and not dev["has_cat"]:
+            return walk_trees_pallas(
+                x, dev["feats"], dev["thr"], dev["lefts"], dev["rights"],
+                dev["is_leaf"], dev["values"], max_depth=dev["max_depth"],
+            )
         return walk_trees_raw(
             x, dev["feats"], dev["thr"], dev["is_cat"],
             dev["cat_mask"], dev["lefts"], dev["rights"],

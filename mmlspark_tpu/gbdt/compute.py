@@ -96,9 +96,17 @@ def hist_block(n: int) -> int:
     return _HIST_BLK_SMALL
 
 
+def _pallas_interpret() -> bool:
+    """True when Pallas kernels must run in interpret mode: no TPU backend
+    is attached, so Mosaic can't compile, but the kernel *body* still runs
+    as plain JAX ops. This is how tier-1 (JAX_PLATFORMS=cpu) exercises the
+    actual kernel arithmetic instead of only the einsum fallback."""
+    return jax.default_backend() != "tpu"
+
+
 def _route_hist_pallas(binsT, grad, hess, smask_f, assign, memberT,
                        feat, slot, new_slot, small_slot, num_bins: int,
-                       n_bins_static=None):
+                       n_bins_static=None, interpret=None):
     """Fused row-routing + small-child histogram as ONE Pallas TPU kernel.
 
     Inputs (device):
@@ -134,6 +142,8 @@ def _route_hist_pallas(binsT, grad, hess, smask_f, assign, memberT,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if interpret is None:
+        interpret = _pallas_interpret()
     F, n = binsT.shape
     B = num_bins
     BLK = hist_block(n)
@@ -229,6 +239,7 @@ def _route_hist_pallas(binsT, grad, hess, smask_f, assign, memberT,
             jax.ShapeDtypeStruct((1, n), jnp.int32),
             jax.ShapeDtypeStruct((F, _HIST_STATS, B), jnp.float32),
         ],
+        interpret=interpret,
     )(
         jnp.reshape(feat, (1, 1)).astype(jnp.int32),
         jnp.reshape(slot, (1, 1)).astype(jnp.int32),
@@ -567,7 +578,16 @@ def _grow_tree_body(
         """hist (F,B,3) -> (gain, feat, thr_bin, is_cat, member(B,),
         left(3,), right(3,)). gain=-inf when no valid split. The shared
         rule lives in _best_split_impl (the streamed grower calls it on
-        chunk-accumulated histograms)."""
+        chunk-accumulated histograms); under the Pallas tier the
+        all-numeric case runs the _split_scan_pallas kernel instead
+        (categorical features keep the reference rule — einsum fallback)."""
+        if hist_impl == "pallas" and cat_static is not None \
+                and not any(cat_static):
+            out = _best_splits_pallas_numeric(
+                hist[None], depth_ok, n_bins_arr, feature_mask,
+                min_data, min_hess, l1, l2, num_bins=B,
+            )
+            return tuple(o[0] for o in out)
         return _best_split_impl(
             hist, depth_ok, n_bins_arr, categorical_arr, feature_mask,
             min_data, min_hess, l1, l2,
@@ -968,10 +988,293 @@ def walk_trees_raw(x, feats, thresholds, is_cat, cat_masks, lefts, rights,
     return outs.T
 
 
+# Pallas scoring kernel: rows per grid step (lane-oriented — rows live on
+# lanes so every per-row quantity is a full-width (1, BLK) vector row).
+_WALK_BLK = 512
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "interpret"))
+def walk_trees_pallas(x, feats, thresholds, lefts, rights, is_leaf, values,
+                      *, max_depth: int, interpret=None):
+    """Fused Pallas ensemble scoring from RAW float features — the
+    NUMERIC-tree fast path of walk_trees_raw as one kernel.
+
+    Same packed (T, m) layout and traversal rule as walk_trees_raw (NaN
+    routes left; leaves absorb), minus the categorical branch — the
+    Booster dispatches here only when no node in the ensemble is
+    categorical, and falls back to the walk_trees_raw einsum/gather path
+    otherwise. Per grid step (row block, tree) the kernel gathers node
+    fields with a one-hot MXU matmul over the node table (each row selects
+    exactly one node, so the f32 dot IS the gather — bit-exact), selects
+    the split feature the same way over the transposed row block, and
+    steps `max_depth` times. Outputs are bitwise identical to
+    walk_trees_raw: every emitted value is a leaf value copied, never
+    accumulated.
+
+    x: (n, F) f32 (NaN allowed); tree arrays (T, m) as in walk_trees_raw.
+    -> (n, T) leaf outputs.
+    """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = _pallas_interpret()
+    n, F = x.shape
+    T, m = feats.shape
+    BLK = _WALK_BLK
+    n_pad = -(-n // BLK) * BLK
+    F_pad = -(-F // 8) * 8
+    N_pad = -(-m // 128) * 128
+
+    # features on sublanes, rows on lanes: the per-step feature select is a
+    # masked sublane reduction into a (1, BLK) row — no transposes in-kernel
+    xT = jnp.pad(x.astype(jnp.float32).T, ((0, F_pad - F), (0, n_pad - n)))
+    # node table (T, 8, N_pad) f32 rows: [feat, thr, left, right, leaf,
+    # value, 0, 0] — int fields are exact in f32 (node/feature ids < 2^24).
+    # The one-hot gather multiplies EVERY table cell by 0 or 1, so the
+    # packed layout's thr=+inf leaf sentinel would poison the dot
+    # (inf * 0 = NaN in every gathered threshold); non-finite thresholds
+    # clamp to f32 max instead. Leaf rows are never compared (absorption
+    # keeps idx first), and real split thresholds are finite, so routing
+    # is unchanged.
+    pad_n = lambda a: jnp.pad(a.astype(jnp.float32), ((0, 0), (0, N_pad - m)))
+    thr_f = thresholds.astype(jnp.float32)
+    thr_f = jnp.where(jnp.isfinite(thr_f), thr_f,
+                      jnp.float32(np.finfo(np.float32).max))
+    table = jnp.stack(
+        [
+            pad_n(feats), pad_n(thr_f), pad_n(lefts), pad_n(rights),
+            pad_n(is_leaf), pad_n(values),
+            jnp.zeros((T, N_pad), jnp.float32),
+            jnp.zeros((T, N_pad), jnp.float32),
+        ],
+        axis=1,
+    )
+
+    def kernel(x_ref, t_ref, o_ref):
+        tbl = t_ref[0]                                     # (8, N_pad)
+        xb = x_ref[:]                                      # (F_pad, BLK)
+        iota_n = jax.lax.broadcasted_iota(jnp.int32, (N_pad, BLK), 0)
+        iota_f = jax.lax.broadcasted_iota(jnp.int32, (F_pad, BLK), 0)
+        idx = jnp.zeros((1, BLK), jnp.int32)
+
+        def gather_fields(node):
+            oh = (iota_n == node).astype(jnp.float32)      # (N_pad, BLK)
+            return jax.lax.dot_general(
+                tbl, oh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                              # (8, BLK)
+
+        for _ in range(max_depth):
+            fields = gather_fields(idx)
+            feat_v = fields[0:1, :].astype(jnp.int32)
+            thr_v = fields[1:2, :]
+            left_v = fields[2:3, :]
+            right_v = fields[3:4, :]
+            leaf_v = fields[4:5, :]
+            fone = iota_f == feat_v
+            fv = jnp.sum(jnp.where(fone, xb, 0.0), axis=0,
+                         keepdims=True)                    # (1, BLK)
+            go_left = jnp.isnan(fv) | (fv <= thr_v)
+            nxt = jnp.where(go_left, left_v, right_v)
+            idx = jnp.where(
+                leaf_v > 0.5, idx.astype(jnp.float32), nxt
+            ).astype(jnp.int32)
+        o_ref[:] = gather_fields(idx)[5:6, :]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // BLK, T),
+        in_specs=[
+            pl.BlockSpec((F_pad, BLK), lambda i, t: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, N_pad), lambda i, t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, BLK), lambda i, t: (t, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((T, n_pad), jnp.float32),
+        interpret=bool(interpret),
+    )(xT, table)
+    return out[:, :n].T
+
+
+def _split_scan_pallas(gT, hT, cT, nbf, fmf, depth_ok, min_data, min_hess,
+                       l1, l2, *, interpret: bool):
+    """Per-feature best-split prefix scan as ONE Pallas TPU kernel — the
+    numeric half of _best_split_impl, computed on-chip per candidate leaf.
+
+    Inputs are bin-major transposed histograms gT/hT/cT (M, Bp, Fp) f32
+    (bins on sublanes, features on lanes — reductions and the prefix scan
+    run along sublanes, per-feature results land as full-lane rows), plus
+    per-feature bin counts / feature mask as (1, Fp) f32 rows and five
+    traced scalars in SMEM.
+
+    Per grid step m the kernel computes totals, the bin prefix sums (a
+    lower-triangular f32 matmul on the MXU — same sums as jnp.cumsum, MXU
+    accumulation order), the reference gain formula, and the FIRST-max
+    threshold per feature (max + first-index-of-max, the exact tie rule of
+    jnp.argmax(ngain, axis=1)). Outputs per leaf: per-feature best gain
+    (M, Fp), best threshold bin (M, Fp) i32, and an (M, 8, Fp) stats block
+    [left g/h/c at the best cut, total g/h/c, 0, 0]. Feature selection
+    (first-argmax over features) happens outside, in the same jnp ops as
+    the reference's all-numeric early return.
+    """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M, Bp, Fp = gT.shape
+    NEG = np.float32(-np.inf)
+
+    def kernel(dok_ref, md_ref, mh_ref, l1_ref, l2_ref,
+               g_ref, h_ref, c_ref, nb_ref, fm_ref,
+               gain_ref, thr_ref, ls_ref):
+        g = g_ref[0]          # (Bp, Fp)
+        h = h_ref[0]
+        c = c_ref[0]
+        nb = nb_ref[:]        # (1, Fp) f32 bin counts
+        fm = fm_ref[:] > 0.5  # (1, Fp)
+        dok = dok_ref[0, 0] > 0.5
+        md = md_ref[0, 0]
+        mh = mh_ref[0, 0]
+        l1v = l1_ref[0, 0]
+        l2v = l2_ref[0, 0]
+
+        def score(gv, hv):
+            t = jnp.sign(gv) * jnp.maximum(jnp.abs(gv) - l1v, 0.0)
+            return t * t / jnp.maximum(hv + l2v, 1e-35)
+
+        tg = jnp.sum(g, axis=0, keepdims=True)   # (1, Fp)
+        th_ = jnp.sum(h, axis=0, keepdims=True)
+        tc = jnp.sum(c, axis=0, keepdims=True)
+        parent = score(tg, th_)
+        leaf_ok = (tc >= 2.0 * md) & fm & dok
+
+        # prefix sums along bins: lower-triangular ones matmul (MXU) —
+        # L[i, j] = j <= i, gl = L @ g. Same cell sums as jnp.cumsum, MXU
+        # accumulation order (identical whenever the addends' sums are
+        # exactly representable; f32-ulp band otherwise).
+        ii = jax.lax.broadcasted_iota(jnp.int32, (Bp, Bp), 0)
+        jj = jax.lax.broadcasted_iota(jnp.int32, (Bp, Bp), 1)
+        L = (jj <= ii).astype(jnp.float32)
+        dot = lambda a, b: jax.lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        gl, hl, cl = dot(L, g), dot(L, h), dot(L, c)
+        gr, hr, cr = tg - gl, th_ - hl, tc - cl
+
+        tpos = jax.lax.broadcasted_iota(jnp.int32, (Bp, Fp), 0)
+        nvalid = (
+            (tpos >= 1)
+            & (tpos.astype(jnp.float32) <= nb - 2.0)
+            & (cl >= md) & (cr >= md)
+            & (hl >= mh) & (hr >= mh)
+            & leaf_ok
+        )
+        ngain = jnp.where(nvalid, score(gl, hl) + score(gr, hr) - parent, NEG)
+        # first max along bins == jnp.argmax(ngain, axis): max value, then
+        # the smallest bin index attaining it
+        mx = jnp.max(ngain, axis=0, keepdims=True)          # (1, Fp)
+        cand = jnp.where(ngain == mx, tpos, jnp.int32(Bp))
+        best_t = jnp.min(cand, axis=0, keepdims=True)       # (1, Fp)
+        sel = tpos == best_t                                 # one per column
+        pick = lambda a: jnp.sum(jnp.where(sel, a, 0.0), axis=0,
+                                 keepdims=True)
+        gain_ref[:] = pick(ngain)
+        thr_ref[:] = best_t
+        ls_ref[0] = jnp.concatenate(
+            [pick(gl), pick(hl), pick(cl), tg, th_, tc,
+             jnp.zeros((2, Fp), jnp.float32)], axis=0
+        )
+
+    smem = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    leaf3 = pl.BlockSpec((1, Bp, Fp), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM)
+    frow = pl.BlockSpec((1, Fp), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    out_row = pl.BlockSpec((1, Fp), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    as_smem = lambda v: jnp.reshape(v, (1, 1)).astype(jnp.float32)
+    return pl.pallas_call(
+        kernel,
+        grid=(M,),
+        in_specs=[smem] * 5 + [leaf3, leaf3, leaf3, frow, frow],
+        out_specs=[
+            out_row, out_row,
+            pl.BlockSpec((1, 8, Fp), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((M, Fp), jnp.float32),
+            jax.ShapeDtypeStruct((M, Fp), jnp.int32),
+            jax.ShapeDtypeStruct((M, 8, Fp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        as_smem(depth_ok), as_smem(min_data), as_smem(min_hess),
+        as_smem(l1), as_smem(l2), gT, hT, cT, nbf, fmf,
+    )
+
+
+def _best_splits_pallas_numeric(
+    hists, depth_ok, n_bins_arr, feature_mask,
+    min_data, min_hess, l1, l2, *, num_bins: int, interpret=None,
+):
+    """Traced all-numeric split finder over the _split_scan_pallas kernel:
+    pad/transpose to the kernel's bin-major layout, scan on-chip, then
+    apply the reference's all-numeric feature-selection rule (first argmax
+    over features) verbatim outside. Shared by best_splits_for_hists
+    (streamed/data-parallel host-driven growers) and the fused grower's
+    per-leaf best_split — pure traced code, safe inside an enclosing jit."""
+    import jax.numpy as jnp
+
+    if interpret is None:
+        interpret = _pallas_interpret()
+    M, F = hists.shape[0], hists.shape[1]
+    B = num_bins
+    Fp = -(-F // 128) * 128
+    Bp = -(-B // 8) * 8
+    h4 = jnp.pad(
+        hists.astype(jnp.float32),
+        ((0, 0), (0, Fp - F), (0, Bp - B), (0, 0)),
+    )
+    gT = h4[..., 0].transpose(0, 2, 1)   # (M, Bp, Fp)
+    hT = h4[..., 1].transpose(0, 2, 1)
+    cT = h4[..., 2].transpose(0, 2, 1)
+    nbf = jnp.zeros((1, Fp), jnp.float32).at[0, :F].set(
+        n_bins_arr.astype(jnp.float32)
+    )
+    fmf = jnp.zeros((1, Fp), jnp.float32).at[0, :F].set(
+        feature_mask.astype(jnp.float32)
+    )
+    gains, thrs, ls = _split_scan_pallas(
+        gT, hT, cT, nbf, fmf, depth_ok, min_data, min_hess, l1, l2,
+        interpret=bool(interpret),
+    )
+    gains = gains[:, :F]
+    # feature pick: the reference's all-numeric early return, verbatim
+    f_star = jnp.argmax(gains, axis=1).astype(jnp.int32)
+    gain = jnp.take_along_axis(gains, f_star[:, None], 1)[:, 0]
+    t_star = jnp.take_along_axis(
+        thrs[:, :F], f_star[:, None], 1
+    )[:, 0].astype(jnp.int32)
+    member = jnp.arange(B)[None, :] <= t_star[:, None]
+    lsf = jnp.take_along_axis(
+        ls, f_star[:, None, None], 2
+    )[:, :, 0]                                    # (M, 8)
+    left = lsf[:, 0:3]
+    right = lsf[:, 3:6] - left
+    is_cat = jnp.zeros((M,), bool)
+    return gain, f_star, t_star, is_cat, member, left, right
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
         "num_bins", "max_cat_threshold", "n_bins_static", "cat_static",
+        "split_impl", "interpret",
     ),
 )
 def best_splits_for_hists(
@@ -986,6 +1289,8 @@ def best_splits_for_hists(
     max_cat_threshold: int,
     n_bins_static=None,
     cat_static=None,
+    split_impl: str = "reference",
+    interpret=None,
 ):
     """Vectorized best_split over M leaf histograms — the streamed grower's
     split finder. SAME traced arithmetic as the fused grower's per-leaf
@@ -993,9 +1298,25 @@ def best_splits_for_hists(
     way in-memory trees do; only the histogram accumulation order (fixed
     chunk order vs one whole-n contraction) can differ, in f32 ulps.
 
+    split_impl picks the reduction: "reference" is the jitted-vmap over
+    _best_split_impl; "pallas" runs the _split_scan_pallas kernel (per-
+    feature prefix scan on-chip) and applies the reference's all-numeric
+    feature-selection rule outside — tie-breaking is identical (first max
+    over thresholds, first argmax over features). The kernel covers the
+    all-numeric case only: any categorical feature falls back to the
+    reference impl (the categorical prefix machinery stays XLA einsums).
+
     Returns (gain (M,), feat (M,), thr_bin (M,), is_cat (M,),
     member (M, B), left (M, 3), right (M, 3))."""
     import jax.numpy as jnp
+
+    all_numeric = cat_static is not None and not any(cat_static)
+    if split_impl == "pallas" and all_numeric:
+        return _best_splits_pallas_numeric(
+            hists, depth_ok, n_bins_arr, feature_mask,
+            min_data, min_hess, l1, l2,
+            num_bins=num_bins, interpret=interpret,
+        )
 
     def one(h):
         return _best_split_impl(
@@ -1106,6 +1427,25 @@ def route_hist_shard(
     import jax.numpy as jnp
 
     bins = bins.astype(jnp.int32)
+    if hist_impl == "pallas":
+        # per-shard fused routing + histogram (the _route_hist_pallas
+        # design): the shard's rows never leave its device either way, but
+        # the kernel's one-hot stays in VMEM instead of an (m, F, B) bf16
+        # one-hot through HBM. The trainer pads every shard to a
+        # hist_block multiple with zero-weight masked-out rows — exact,
+        # since they add 0.0f to every cell, and count semantics are
+        # unchanged (counts were always over ALL shard rows, pads ride in
+        # leaf 0 exactly like the pre-existing nd-alignment pad rows).
+        na, h16 = _route_hist_pallas(
+            bins.T, grad.astype(jnp.float32), hess.astype(jnp.float32),
+            smask.astype(jnp.float32), assign.astype(jnp.int32),
+            member.astype(jnp.float32)[:, None],
+            feat, slot, new_slot, small_slot, num_bins, n_bins_static,
+        )
+        counts = jnp.stack(
+            [(na == slot).sum(), (na == new_slot).sum()]
+        ).astype(jnp.int32)
+        return na, h16[:, :3, :].transpose(0, 2, 1), counts
     fcol = jnp.take(bins, feat, axis=1)
     go_left = member[fcol]
     new_assign = jnp.where(

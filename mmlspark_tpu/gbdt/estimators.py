@@ -152,6 +152,18 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
         "engine; the rollback lever). docs/gbdt.md Distributed training",
         TypeConverters.to_string,
     )
+    hist_impl = Param(
+        "hist_impl",
+        "Histogram/compute implementation: auto (the hand-written Pallas "
+        "kernel tier on a TPU backend — route+hist and the split-finder "
+        "scan on every engine, except the fused engine's multi-device "
+        "GSPMD program which keeps einsum — else einsum) | pallas (force "
+        "the kernel tier; interpret-mode on CPU) | einsum (the XLA "
+        "one-hot contraction path — the rollback lever). Pinned once per "
+        "fit and carried into the checkpoint fingerprint. docs/gbdt.md "
+        "Pallas compute tier",
+        TypeConverters.to_string,
+    )
     stream_chunk_rows = Param(
         "stream_chunk_rows",
         "Out-of-core fit: bin and spill the dataset in chunks of this many "
@@ -199,6 +211,7 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
             checkpoint_keep_last=3,
             stream_chunk_rows=0,
             engine="auto",
+            hist_impl="auto",
         )
 
     def _train_config(self, categorical_indexes: List[int]) -> TrainConfig:
@@ -227,6 +240,7 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
             other_rate=self.get(self.other_rate),
             verbosity=self.get(self.verbosity),
             engine=self.get(self.engine),
+            hist_impl=self.get(self.hist_impl),
         )
 
     def _categorical_indexes(self, df: DataFrame) -> List[int]:

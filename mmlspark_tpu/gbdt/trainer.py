@@ -78,7 +78,8 @@ def _round_device_hist():
 def _record_boost_device_work(engine: str, shards: int, seconds: float,
                               iterations: int, rows: int, features: int,
                               num_bins: int, num_leaves: int,
-                              num_class: int) -> None:
+                              num_class: int,
+                              hist_impl: str = "einsum") -> None:
     """Per-round device seconds + histogram-pass MFU for a boost run —
     no-ops (like every profiler hook) under obs.disabled().
 
@@ -87,7 +88,14 @@ def _record_boost_device_work(engine: str, shards: int, seconds: float,
     same round wall): rows partition uniformly over the mesh, so each
     device executed 1/shards of the analytic hist flops — on a real pod
     the per-device gauge is the one to compare against the chip's peak,
-    while the aggregate gauge shows the pod-level utilization."""
+    while the aggregate gauge shows the pod-level utilization.
+
+    The analytic 6-flops-per-cell-per-level estimate is impl-independent
+    (pallas and einsum histogram the same cells), so the round's flight
+    record carries flops_source="analytic" plus the active `hist_impl` as
+    attrs — a pallas-vs-einsum MFU delta in /debug/flight is then
+    attributable to the kernel tier, not to a change in the estimate
+    (docs/observability.md "MFU attribution")."""
     from mmlspark_tpu.obs.profiler import device_profiler
 
     prof = device_profiler()
@@ -98,13 +106,19 @@ def _record_boost_device_work(engine: str, shards: int, seconds: float,
     ).observe(seconds / iterations)
     flops = _hist_pass_flops(rows, features, num_bins, num_leaves,
                              num_class) * iterations
+    attrs = {
+        "hist_impl": hist_impl, "engine": engine, "shards": int(shards),
+        "iterations": int(iterations),
+    }
     prof.record_device_work(
         site=f"gbdt:{engine}", model="gbdt", seconds=seconds, flops=flops,
+        rows=rows, flops_source="analytic", attrs=attrs,
     )
     if shards > 1:
         prof.record_device_work(
             site=f"gbdt:{engine}:per_device", model="gbdt_per_device",
             seconds=seconds, flops=flops / shards,
+            rows=rows, flops_source="analytic", attrs=attrs,
         )
 
 
@@ -283,6 +297,11 @@ class TrainConfig:
     # "Distributed training"; the scalar rollback lever for the
     # mesh-sharded trainer)
     engine: str = "auto"
+    # histogram/compute implementation: auto | pallas | einsum
+    # (docs/gbdt.md "Pallas compute tier"; the scalar rollback lever for
+    # the hand-written kernel tier — auto resolves ONCE per fit at the
+    # train_booster entry, like engine)
+    hist_impl: str = "auto"
 
 
 # Auto engine selection routes in-memory fits to the mesh-sharded
@@ -363,6 +382,44 @@ def _resolve_engine(cfg: TrainConfig, n_rows: int, valid_mask, init_raw,
     return "fused"
 
 
+def _resolve_hist_impl(cfg: TrainConfig, engine: str) -> str:
+    """Pin the histogram/compute implementation for this fit — decided
+    ONCE at the outermost train_booster entry (like the engine pick) and
+    carried in cfg, so every checkpoint segment of a fit runs the same
+    kernels and the checkpoint fingerprint can refuse cross-impl resumes.
+
+    - "pallas": the hand-written kernel tier (gbdt/compute.py
+      _route_hist_pallas and friends). On a non-TPU backend the kernels
+      run in Pallas interpret mode — the same arithmetic as plain JAX ops,
+      which is how tier-1 CPU CI exercises the kernel bodies.
+    - "einsum": the XLA one-hot contraction path — the rollback lever.
+    - "auto": pallas on a TPU backend, einsum otherwise. One carve-out:
+      the fused engine on >1 device runs ONE GSPMD-sharded XLA program,
+      and a pallas_call inside a partitioned program has no defined shard
+      semantics — auto keeps the einsum there (whose replicated output
+      XLA turns into the cross-chip psum); the per-device engines
+      (streamed, data_parallel) take the kernel tier on every chip.
+    """
+    import jax
+
+    if cfg.hist_impl not in ("auto", "pallas", "einsum"):
+        raise ValueError(
+            f"unknown GBDT hist_impl {cfg.hist_impl!r}: expected "
+            "auto | pallas | einsum"
+        )
+    if cfg.hist_impl != "auto":
+        return cfg.hist_impl
+    if jax.default_backend() != "tpu":
+        return "einsum"
+    if (
+        engine == "fused"
+        and jax.device_count() > 1
+        and not _FORCE_SINGLE_DEVICE
+    ):
+        return "einsum"
+    return "pallas"
+
+
 def train_booster(
     x: np.ndarray,
     y: np.ndarray,
@@ -396,6 +453,9 @@ def train_booster(
     )
     if cfg.engine != resolved:
         cfg = dataclasses.replace(cfg, engine=resolved)
+    resolved_impl = _resolve_hist_impl(cfg, resolved)
+    if cfg.hist_impl != resolved_impl:
+        cfg = dataclasses.replace(cfg, hist_impl=resolved_impl)
 
     if stream_chunk_rows or _stream_data is not None:
         # Out-of-core fit: the feature matrix is binned and spilled in
@@ -644,13 +704,24 @@ def train_booster(
     n_bins_static = tuple(int(b) for b in binner.n_bins)  # hist grouping
     cat_static = tuple(bool(x) for x in categorical)      # reduced cat view
 
-    # Histogram implementation: the Pallas kernel (compute._hist_pallas)
-    # on a single real TPU chip — the einsum path materializes the one-hot
-    # through HBM (O(n*F*B) traffic, OOM at ~1M rows). Sharded runs keep the
-    # einsum whose replicated output XLA turns into the cross-chip psum.
-    hist_impl = (
-        "pallas" if nd == 1 and jax.default_backend() == "tpu" else "einsum"
-    )
+    # Histogram implementation: pinned per fit in cfg.hist_impl (resolved
+    # ONCE at the train_booster entry; docs/gbdt.md "Pallas compute tier").
+    # The einsum path materializes the one-hot through HBM (O(n*F*B)
+    # traffic, OOM at ~1M rows); the Pallas kernel keeps it in VMEM. One
+    # degradation: the GSPMD-sharded fused program (nd > 1) cannot host a
+    # pallas_call, so an explicit "pallas" falls back to the einsum whose
+    # replicated output XLA turns into the cross-chip psum.
+    hist_impl = cfg.hist_impl
+    if hist_impl == "auto":  # direct callers that bypassed train_booster
+        hist_impl = _resolve_hist_impl(cfg, "fused")
+    if hist_impl == "pallas" and nd > 1:
+        log.warning(
+            "gbdt_hist_impl_fallback", requested="pallas", used="einsum",
+            engine="fused", shards=nd,
+            reason="fused engine runs one GSPMD-sharded program; "
+                   "pallas_call has no shard semantics inside it",
+        )
+        hist_impl = "einsum"
 
     rng = np.random.default_rng(cfg.bagging_seed)
     frng = np.random.default_rng(cfg.bagging_seed + 17)
@@ -835,7 +906,7 @@ def train_booster(
                 _record_boost_device_work(
                     "fused", nd, time.perf_counter() - t_boost,
                     cfg.num_iterations, n_orig, f, num_bins_static,
-                    cfg.num_leaves, k,
+                    cfg.num_leaves, k, hist_impl=hist_impl,
                 )
         finally:
             # a failed fit's dominant phase must still reach the trace ring
@@ -1085,23 +1156,6 @@ def _guard_streaming(cfg: TrainConfig, valid_mask, init_raw) -> None:
             "stream_chunk_rows does not support init_score_col (per-row "
             "base margins); fold margins into the label or fit in-memory"
         )
-
-
-def _stream_hist_impl(engine: str) -> str:
-    """Which histogram kernel streamed chunk passes use: the fused Pallas
-    route+hist on a single real TPU chip (the ROADMAP 'streaming pins
-    einsum' fix), einsum everywhere else — CPU, and sharded streams,
-    where each owner device runs the one-hot contraction locally. Shared
-    by the streamed engine and the checkpoint fingerprint: the two paths
-    differ in f32 ulps, so a store grown on one must not silently resume
-    onto the other."""
-    import jax
-
-    if engine == "data_parallel" and jax.device_count() > 1:
-        return "einsum"
-    if jax.device_count() == 1 and jax.default_backend() == "tpu":
-        return "pallas"
-    return "einsum"
 
 
 _STREAM_METRICS: Dict[str, Any] = {}
@@ -1450,13 +1504,17 @@ def _train_booster_streamed(
             "streamed",
             {device_label(d): device_label(d) for d in devices},
         )
-    # Streamed chunks ride the Pallas route+hist kernel on a single real
-    # TPU chip (chunks padded to the kernel block in the stage step); the
-    # einsum path stays for CPU and for sharded streams, whose replicated
-    # one-hot contraction is what each owner device runs locally. The
-    # pick is shared with the checkpoint fingerprint (_stream_hist_impl):
+    # Streamed chunks ride the compute tier pinned in cfg.hist_impl
+    # (resolved ONCE at the train_booster entry; docs/gbdt.md "Pallas
+    # compute tier"): the Pallas route+hist kernel on TPU — per owner
+    # device, chunk passes are independent single-device programs, so the
+    # kernel serves sharded streams too — with the einsum contraction as
+    # the rollback. Chunks are padded to the kernel block in the stage
+    # step. The pick is shared with the checkpoint fingerprint:
     # pallas-grown stores must not silently resume onto einsum segments.
-    hist_impl = _stream_hist_impl(cfg.engine)
+    hist_impl = cfg.hist_impl
+    if hist_impl == "auto":  # direct callers that bypassed train_booster
+        hist_impl = _resolve_hist_impl(cfg, cfg.engine)
     n_bins_arr = np.asarray(binner.n_bins, np.int32)
     cat_arr = np.asarray(categorical, bool)
     scalars = dict(
@@ -1592,7 +1650,7 @@ def _train_booster_streamed(
             # so the round wall IS queue+device time; no-op when disabled
             _record_boost_device_work(
                 "streamed", n_shards, time.perf_counter() - t_round, 1,
-                n, f, num_bins, cfg.num_leaves, k,
+                n, f, num_bins, cfg.num_leaves, k, hist_impl=hist_impl,
             )
             if cfg.verbosity > 0 and (it % 10 == 0):
                 log.info("gbdt_streamed_progress", iteration=it,
@@ -1757,6 +1815,7 @@ def _stream_grow_tree(
         n_bins_arr, cat_arr, fmask, scalars,
         num_bins, num_leaves, depth_limit, max_cat_threshold,
         n_bins_static, cat_static, learning_rate, grow_cfg, binner,
+        hist_impl=hist_impl,
     )
 
 
@@ -1778,6 +1837,7 @@ def _grow_tree_hostdriven(
     learning_rate: np.float32,
     grow_cfg: GrowConfig,
     binner: BinMapper,
+    hist_impl: str = "einsum",
 ):
     """The host-driven leaf-wise grower shared by the streamed (PR 9) and
     data-parallel (PR 15) engines: identical split bookkeeping over
@@ -1810,6 +1870,7 @@ def _grow_tree_hostdriven(
             scalars["l1"], scalars["l2"],
             num_bins=B, max_cat_threshold=max_cat_threshold,
             n_bins_static=n_bins_static, cat_static=cat_static,
+            split_impl=hist_impl if hist_impl == "pallas" else "reference",
         )
         return [np.asarray(a) for a in out]
 
@@ -2017,7 +2078,17 @@ def _train_booster_data_parallel(
     mesh = data_parallel_mesh()
     devices = list(mesh.devices.flat)
     nd = len(devices)
-    pad = (-n_orig) % nd
+    hist_impl = cfg.hist_impl
+    if hist_impl == "auto":  # direct callers that bypassed train_booster
+        hist_impl = _resolve_hist_impl(cfg, "data_parallel")
+    # the Pallas route+hist kernel tiles rows in hist_block()-sized grid
+    # steps, so under hist_impl="pallas" each shard additionally pads up
+    # to a block multiple — same zero-weight masked-out rows, still exact
+    # (0.0f into every histogram cell), still one program shape per shard
+    from mmlspark_tpu.gbdt.compute import _HIST_BLK_SMALL as _dp_blk
+
+    pad_quantum = nd * (_dp_blk if hist_impl == "pallas" else 1)
+    pad = (-n_orig) % pad_quantum
     n = n_orig + pad
     m = n // nd
     bounds = [(i * m, (i + 1) * m) for i in range(nd)]
@@ -2196,7 +2267,7 @@ def _train_booster_data_parallel(
                 member, np.int32(feat), np.int32(slot),
                 np.int32(new_slot), np.int32(small_slot),
                 num_bins=num_bins, n_bins_static=n_bins_static,
-                hist_impl="einsum",
+                hist_impl=hist_impl,
             )
             assign_d[i] = na
             pending.append((i, hist_i, cnt_i))
@@ -2263,6 +2334,7 @@ def _train_booster_data_parallel(
                     int(grow_cfg.max_cat_threshold),
                     n_bins_static, cat_static,
                     np.float32(cfg.learning_rate), grow_cfg, binner,
+                    hist_impl=hist_impl,
                 )
                 trees.append(tree)
                 for i in range(nd):
@@ -2279,6 +2351,7 @@ def _train_booster_data_parallel(
             _record_boost_device_work(
                 "data_parallel", nd, time.perf_counter() - t_round, 1,
                 n_orig, f, num_bins, cfg.num_leaves, k,
+                hist_impl=hist_impl,
             )
             if cfg.verbosity > 0 and (it % 10 == 0):
                 log.info("gbdt_dp_progress", iteration=it,
@@ -2327,7 +2400,7 @@ def _gbdt_fingerprint(x: Optional[np.ndarray], y: np.ndarray,
                       stream_chunk_rows: int = 0,
                       stream_bins_sha: Optional[str] = None,
                       dp_shards: int = 0,
-                      stream_hist_impl: Optional[str] = None) -> str:
+                      hist_impl: Optional[str] = None) -> str:
     """Identity of (config, data, weights, validation split, objective,
     warm-start inputs) a GBDT checkpoint may resume against. Data is
     sampled (64 rows) — cheap at 100M rows, still collision-proof against
@@ -2347,6 +2420,10 @@ def _gbdt_fingerprint(x: Optional[np.ndarray], y: np.ndarray,
     # field). What IS identity-bearing about sharding — the accumulation
     # partition — enters via dp_shards below, only when sharded.
     ident.pop("engine", None)
+    # the hist_impl knob likewise pops from the raw cfg dict (pre-PR19
+    # stores predate the field); the RESOLVED impl re-enters below as an
+    # explicit key only when it is not the einsum default
+    ident.pop("hist_impl", None)
     ident["categorical_indexes"] = list(ident["categorical_indexes"])
     ident["objective"] = objective.kind
     ident["num_class"] = getattr(objective, "num_class", 1)
@@ -2372,14 +2449,17 @@ def _gbdt_fingerprint(x: Optional[np.ndarray], y: np.ndarray,
         # mid-ensemble. Unsharded (and streamed: chunk order is
         # nd-independent) fits keep their pre-PR15 fingerprints.
         ident["dp_shards"] = int(dp_shards)
-    if stream_hist_impl and stream_hist_impl != "einsum":
-        # streamed pallas (single-device TPU) histograms differ from the
-        # einsum path in f32 ulps, so a pallas-grown store must refuse to
-        # resume onto einsum segments (and vice versa: a pre-PR15 einsum
-        # store resumed on a now-pallas chip mismatches here instead of
-        # silently mixing kernels mid-ensemble). einsum stores keep their
-        # pre-PR15 fingerprints.
-        ident["stream_hist_impl"] = stream_hist_impl
+    if hist_impl and hist_impl != "einsum":
+        # pallas and einsum histograms differ in f32 ulps, so a
+        # pallas-grown store must refuse to resume onto einsum segments on
+        # ANY engine (and vice versa: a pre-PR19 einsum store resumed
+        # under a now-pallas pick mismatches here instead of silently
+        # mixing kernels mid-ensemble). einsum fits keep their pre-PR19
+        # fingerprints byte-identical. Streamed fits keep the PR 15 key
+        # NAME, so pallas-grown streamed stores written before the
+        # per-engine generalization keep resuming too.
+        key = "stream_hist_impl" if stream_chunk_rows else "hist_impl"
+        ident[key] = hist_impl
     # warm-start keys enter the ident only when present: a plain fit's
     # fingerprint stays byte-identical to stores written before these
     # inputs were covered, so existing checkpoints keep resuming — while
@@ -2489,9 +2569,7 @@ def _train_booster_checkpointed(
         stream_bins_sha=(data.bins_sample_sha
                          if x is None and data is not None else None),
         dp_shards=dp_shards,
-        stream_hist_impl=(
-            _stream_hist_impl(cfg.engine) if data is not None else None
-        ),
+        hist_impl=cfg.hist_impl,
     )
 
     try:
@@ -2519,7 +2597,14 @@ def _train_booster_checkpointed(
                         "gbdt_resume_engine_fallback",
                         store_engine="fused", pinned="data_parallel",
                     )
-                    cfg = dataclasses.replace(cfg, engine="fused")
+                    # the legacy fingerprint carries no hist_impl key, so
+                    # the store was grown on the einsum path — pin it for
+                    # the continuation too (bit-identical trees), rather
+                    # than relying on the fused engine's runtime GSPMD
+                    # pallas->einsum degradation
+                    cfg = dataclasses.replace(
+                        cfg, engine="fused", hist_impl="einsum"
+                    )
                     fingerprint = legacy
             if ck.meta.get("fingerprint") != fingerprint:
                 raise ValueError(

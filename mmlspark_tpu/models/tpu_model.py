@@ -228,10 +228,12 @@ class TPUModel(Model, Wrappable):
     dtype = Param(
         "dtype",
         "Compute dtype override for network evaluation: bfloat16 halves "
-        "MXU cycle cost on TPU, float32 forces full precision (the "
+        "MXU cycle cost on TPU, int8 quantizes resident kernels to "
+        "per-channel int8 codes (quarter weight bytes; activations stay "
+        "float32 — dnn/quant.py), float32 forces full precision (the "
         "rollback). Empty (the default) inherits the bundle network's own "
-        "compute dtype, so bf16 zoo variants stay bf16. Output columns "
-        "stay float32; parity is gated by the zoo bf16 tests",
+        "compute dtype, so bf16/int8 zoo variants keep theirs. Output "
+        "columns stay float32; parity is gated by the zoo bf16/int8 tests",
         TypeConverters.to_string,
     )
 
@@ -303,8 +305,24 @@ class TPUModel(Model, Wrappable):
 
     # -- compiled eval ---------------------------------------------------------
 
+    def _bundle_for_eval(self) -> NetworkBundle:
+        """The bundle whose variables this stage scores with. dtype="int8"
+        needs a QUANTIZED variables tree, not just a recompiled program —
+        the int8 twin is derived once per set bundle and cached (its own
+        one-time weight upload, a quarter of the f32 kernel bytes)."""
+        bundle = self.get_model()
+        if self.get(self.dtype) == "int8" \
+                and bundle.network.compute_dtype != "int8":
+            from mmlspark_tpu.dnn.zoo_builders import int8_variant
+
+            cached = getattr(self, "_int8_twin", None)
+            if cached is None or cached[0] is not bundle:
+                self._int8_twin = (bundle, int8_variant(bundle))
+            return self._int8_twin[1]
+        return bundle
+
     def _network_for_eval(self) -> Network:
-        net = self.get_model().network
+        net = self._bundle_for_eval().network
         if self.is_set(self.output_layer):
             net = net.truncate_at(self.get(self.output_layer))
         want = self.get(self.dtype)  # "" = inherit the network's own dtype
@@ -324,7 +342,7 @@ class TPUModel(Model, Wrappable):
         """
         import jax
 
-        bundle = self.get_model()
+        bundle = self._bundle_for_eval()
         bs = self.get(self.mini_batch_size)
         net = self._network_for_eval()
         fn = _compiled_forward(net)

@@ -306,13 +306,52 @@ class DeviceProfiler:
                                     float(device_s))
 
     def record_device_work(self, *, site: str, model: str, seconds: float,
-                           flops: float, nbytes: float = 0.0) -> None:
+                           flops: float, nbytes: float = 0.0,
+                           rows: Optional[int] = None,
+                           flops_source: Optional[str] = None,
+                           attrs: Optional[Dict[str, Any]] = None) -> None:
         """Aggregate device work that is not a single cached dispatch (a
         GBDT boost phase, a training epoch): feeds the same
         dispatch_device_seconds histogram and rolling MFU gauges. `flops`
-        is usually an analytic estimate — callers document theirs."""
+        is usually an analytic estimate — callers document theirs.
+
+        When `flops_source`/`attrs` are given, the work also lands in the
+        flight recorder so the MFU feed is ATTRIBUTABLE after the fact:
+        e.g. the GBDT trainer stamps the active `hist_impl` and engine on
+        every round record, which is what lets /debug/flight separate
+        pallas-tier from einsum-tier `device_mfu` samples
+        (docs/observability.md "MFU attribution")."""
         if not self.enabled or seconds <= 0:
             return
+        if flops_source is not None or attrs is not None:
+            t_done = time.monotonic()
+            span = current_span()
+            rec: Dict[str, Any] = {
+                "site": site,
+                "model": model,
+                "program": None,
+                "signature": None,
+                "rows": None if rows is None else int(rows),
+                "t_queue": round(_epoch(t_done - seconds), 6),
+                "t_dispatch": round(_epoch(t_done - seconds), 6),
+                "t_done": round(_epoch(t_done), 6),
+                "device_s": round(float(seconds), 6),
+                "sampled": True,
+                "flops": float(flops),
+                "flops_source": flops_source,
+                "bytes": float(nbytes) if nbytes else None,
+                "donated": False,
+                "cache_hit": True,
+                "attrs": {k: _jsonable_sig(v) for k, v in (attrs or {}).items()},
+                "trace_id": (
+                    span.trace_id if span is not None and span.recording
+                    else None
+                ),
+            }
+            with self._lock:
+                self._records.append(rec)
+                self._total_records += 1
+            self._flight_total.inc()
         self._device_hist.labels(site=site).observe(float(seconds))
         self._update_window(model, float(flops), float(nbytes),
                             float(seconds))

@@ -1,0 +1,68 @@
+"""Fixture for the kernel-without-fallback rule: a Pallas kernel site with
+no visible rollback arm. Parsed, never imported."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def bad_tpu_only(x):
+    def body(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    return pl.pallas_call(  # expect[kernel-without-fallback]
+        body,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def good_interpret_kwarg(x):
+    def body(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    # clean: the interpret pick gives tier-1 CPU a path through the body
+    return pl.pallas_call(
+        body,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(x)
+
+
+def good_interpret_param(x, *, interpret=False):
+    def body(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    # clean: the caller owns the interpret pick via the signature
+    if interpret:
+        return body_reference(x)
+    return pl.pallas_call(
+        body,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def good_impl_dispatch(x, hist_impl):
+    def body(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    # clean: selectable reference arm beside the kernelized one
+    if hist_impl == "pallas":
+        return pl.pallas_call(
+            body,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        )(x)
+    return jnp.einsum("nf,nf->f", x, x)
+
+
+def justified_tpu_only(x):
+    def body(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    return pl.pallas_call(  # graftcheck: ignore[kernel-without-fallback]  # expect-suppressed[kernel-without-fallback]
+        body,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def body_reference(x):
+    return x * 2.0

@@ -1,0 +1,441 @@
+"""ISSUE 19 tests: the Pallas-by-default GBDT compute tier, on CPU.
+
+Every kernel in the tier carries a Pallas interpret-mode path, so this
+suite executes the ACTUAL kernel bodies (route+hist, split finder, fused
+scoring walk, int8 dequant matmul) under `JAX_PLATFORMS=cpu` — not a
+shadow implementation. The contracts under test (docs/gbdt.md "Pallas
+compute tier"):
+
+- route+hist is EXACT: trees grown under ``hist_impl="pallas"`` are
+  bit-identical to ``hist_impl="einsum"`` on every engine — masked
+  padding rows carry zero weight and add 0.0f to every histogram cell;
+- the split-finder kernel makes IDENTICAL decisions (feature, threshold,
+  same first-max/first-argmax tie-breaking) with gains in an f32-ulp
+  band, and silently defers to the reference impl when any feature is
+  categorical;
+- fused Pallas scoring is bitwise identical to the reference walk,
+  including NaN routing and multiclass ensembles;
+- int8 weight-only quantization: per-channel codes within the documented
+  error bound, the dequant-in-VMEM matmul against the XLA factorization,
+  and the parity-gated network dispatch;
+- checkpoint fingerprints: einsum fits keep pre-PR19 byte-identical
+  fingerprints, pallas fits refuse to resume onto einsum segments on any
+  engine, and streamed fits keep the PR 15 ``stream_hist_impl`` key NAME.
+
+TPU-hardware behavior (auto->pallas resolution, compiled-kernel parity,
+MFU attribution deltas) lives in tests/test_tpu_kernels.py.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.gbdt import trainer as trainer_mod
+from mmlspark_tpu.gbdt.objectives import make_objective
+from mmlspark_tpu.gbdt.trainer import (
+    TrainConfig,
+    _gbdt_fingerprint,
+    _resolve_hist_impl,
+    train_booster,
+)
+
+OBJ = make_objective("binary", num_class=2)
+
+
+def _data(n=768, f=10, seed=0, cat=False):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f))
+    if cat:
+        x[:, f - 1] = rng.integers(0, 7, n)
+    y = ((x[:, 0] + 0.5 * x[:, 1] - 0.3 * x[:, 2]
+          + rng.normal(scale=0.5, size=n)) > 0).astype(np.float64)
+    return x, y
+
+
+def _fit(x, y, engine, hist_impl, stream=0, single=False, **cfg_kw):
+    cfg = TrainConfig(num_iterations=3, num_leaves=7, max_bin=31,
+                      verbosity=0, engine=engine, hist_impl=hist_impl,
+                      **cfg_kw)
+    if single:
+        trainer_mod._FORCE_SINGLE_DEVICE = True
+    try:
+        return train_booster(x, y, OBJ, cfg, stream_chunk_rows=stream)
+    finally:
+        trainer_mod._FORCE_SINGLE_DEVICE = False
+
+
+# -- hist_impl resolution ------------------------------------------------------
+
+
+class TestHistImplResolution:
+    def test_unknown_impl_raises(self):
+        with pytest.raises(ValueError, match="hist_impl"):
+            _resolve_hist_impl(TrainConfig(hist_impl="cuda"), "fused")
+
+    def test_auto_resolves_einsum_off_tpu(self):
+        """On this CPU backend auto keeps the einsum rollback default on
+        every engine — interpret-mode kernels are a parity vehicle, not a
+        win, so they must be asked for explicitly."""
+        cfg = TrainConfig(hist_impl="auto")
+        for engine in ("fused", "data_parallel"):
+            assert _resolve_hist_impl(cfg, engine) == "einsum"
+
+    def test_explicit_pick_is_honored(self):
+        for impl in ("pallas", "einsum"):
+            cfg = TrainConfig(hist_impl=impl)
+            assert _resolve_hist_impl(cfg, "data_parallel") == impl
+
+    def test_pick_pinned_once_in_trained_config(self):
+        """train_booster resolves auto before any dispatch, so checkpoint
+        segments and flight-record attrs all see the pinned value."""
+        x, y = _data(n=256)
+        b = _fit(x, y, "fused", "auto", single=True)
+        assert b is not None  # the fit ran; resolution didn't raise
+
+
+# -- route+hist kernel: trees bit-identical per engine -------------------------
+
+
+class TestRouteHistParity:
+    def _pair(self, **kw):
+        x, y = _data()
+        bp = _fit(x, y, hist_impl="pallas", **kw)
+        be = _fit(x, y, hist_impl="einsum", **kw)
+        return bp.model_to_string(), be.model_to_string()
+
+    def test_fused_trees_bit_identical(self):
+        p, e = self._pair(engine="fused", single=True)
+        assert p == e
+
+    def test_data_parallel_trees_bit_identical(self):
+        """The dp engine pads each shard up to a hist-block multiple under
+        pallas (n=768 on the 8-way mesh -> 96-row shards padded to 2048);
+        the masked pad rows must not move a single bit."""
+        p, e = self._pair(engine="data_parallel")
+        assert p == e
+
+    def test_streamed_trees_bit_identical(self):
+        # chunk size deliberately NOT a block multiple: exercises the pad
+        p, e = self._pair(engine="data_parallel", stream=300)
+        assert p == e
+
+    def test_categorical_fit_survives_pallas_pick(self):
+        """Categorical features keep the reference split machinery (the
+        kernel is numeric-only) while route+hist stays kernelized — the
+        mixed fit must still match einsum bit-for-bit."""
+        x, y = _data(cat=True)
+        kw = dict(categorical_indexes=(x.shape[1] - 1,))
+        bp = _fit(x, y, "fused", "pallas", single=True, **kw)
+        be = _fit(x, y, "fused", "einsum", single=True, **kw)
+        assert bp.model_to_string() == be.model_to_string()
+
+
+# -- Pallas split finder -------------------------------------------------------
+
+
+def _hists(m=8, f=16, b=16, seed=3):
+    rng = np.random.default_rng(seed)
+    cnt = rng.integers(1, 40, size=(m, f, b)).astype(np.float32)
+    return np.stack([
+        rng.normal(size=(m, f, b)).astype(np.float32) * cnt,
+        rng.uniform(0.1, 1.0, size=(m, f, b)).astype(np.float32) * cnt,
+        cnt,
+    ], axis=-1)
+
+
+def _find(hists, impl, cat=None, min_data=1.0, min_hess=1e-3):
+    from mmlspark_tpu.gbdt.compute import best_splits_for_hists
+
+    m, f, b, _ = hists.shape
+    cat = tuple([False] * f) if cat is None else cat
+    out = best_splits_for_hists(
+        hists, True, np.full(f, b, np.int32),
+        np.asarray(cat, bool), np.ones(f, bool),
+        np.float32(min_data), np.float32(min_hess),
+        np.float32(0.0), np.float32(1.0),
+        num_bins=b, max_cat_threshold=8, cat_static=cat, split_impl=impl,
+    )
+    return [np.asarray(a) for a in out]
+
+
+class TestSplitFinderKernel:
+    def test_decisions_identical_gains_in_band(self):
+        ref, ker = _find(_hists(), "reference"), _find(_hists(), "pallas")
+        np.testing.assert_array_equal(ref[1], ker[1])  # feature
+        np.testing.assert_array_equal(ref[2], ker[2])  # threshold bin
+        np.testing.assert_allclose(ref[0], ker[0], rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(ref[4], ker[4])  # member mask
+        # left/right stats feed leaf values — same ulp band as gains
+        np.testing.assert_allclose(ref[5], ker[5], rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(ref[6], ker[6], rtol=1e-5, atol=1e-5)
+
+    def test_tie_breaking_identical_on_duplicate_features(self):
+        """Two byte-identical feature histograms produce an exact gain
+        tie; both impls must pick the FIRST feature (and the first
+        maximizing threshold within it) — the documented tie-break rule."""
+        h = _hists(m=4, f=6)
+        h[:, 3] = h[:, 1]  # exact duplicate -> guaranteed argmax tie
+        ref, ker = _find(h, "reference"), _find(h, "pallas")
+        np.testing.assert_array_equal(ref[1], ker[1])
+        np.testing.assert_array_equal(ref[2], ker[2])
+
+    def test_min_data_min_hess_filtering_identical(self):
+        h = _hists(seed=5)
+        ref = _find(h, "reference", min_data=60.0, min_hess=20.0)
+        ker = _find(h, "pallas", min_data=60.0, min_hess=20.0)
+        np.testing.assert_array_equal(ref[1], ker[1])
+        np.testing.assert_array_equal(ref[2], ker[2])
+        # invalid-everywhere leaves gate identically (gain <= 0 both arms)
+        np.testing.assert_array_equal(ref[0] > 0, ker[0] > 0)
+
+    def test_categorical_falls_back_to_reference(self):
+        """Any categorical feature routes the WHOLE call to the reference
+        impl — outputs are equal to the reference's exactly (same code)."""
+        h = _hists(m=4, f=6)
+        cat = (False, True, False, False, False, False)
+        ref = _find(h, "reference", cat=cat)
+        ker = _find(h, "pallas", cat=cat)
+        for a, b in zip(ref, ker):
+            np.testing.assert_array_equal(a, b)
+
+
+# -- fused Pallas scoring ------------------------------------------------------
+
+
+class TestScoringKernel:
+    def _booster(self, cat=False, multiclass=False):
+        x, y = _data(cat=cat, seed=7)
+        if cat:
+            # the categorical slot must actually drive the label, or no
+            # tree ever takes a categorical split and has_cat stays False
+            y = np.where(np.isin(x[:, -1], (1, 4, 6)),
+                         1.0 - y, y)
+        if multiclass:
+            rng = np.random.default_rng(8)
+            y = rng.integers(0, 3, x.shape[0]).astype(np.float64)
+            obj = make_objective("multiclass", num_class=3)
+        else:
+            obj = OBJ
+        cfg = TrainConfig(num_iterations=3, num_leaves=7, max_bin=31,
+                          verbosity=0,
+                          categorical_indexes=(x.shape[1] - 1,) if cat
+                          else ())
+        trainer_mod._FORCE_SINGLE_DEVICE = True
+        try:
+            return train_booster(x, y, obj, cfg), x
+        finally:
+            trainer_mod._FORCE_SINGLE_DEVICE = False
+
+    def _walk(self, b, x, impl):
+        b._walk_impl = impl
+        try:
+            return np.asarray(b.predict_raw(x.astype(np.float32)))
+        finally:
+            b._walk_impl = "auto"
+
+    def test_kernel_walk_bitwise_identical(self):
+        b, x = self._booster()
+        assert np.array_equal(self._walk(b, x, "pallas"),
+                              self._walk(b, x, "raw"))
+
+    def test_nan_features_route_left_identically(self):
+        b, x = self._booster()
+        x = x.copy()
+        x[::3, 0] = np.nan  # NaN goes left — both walks, same bit pattern
+        assert np.array_equal(self._walk(b, x, "pallas"),
+                              self._walk(b, x, "raw"))
+
+    def test_multiclass_bitwise_identical(self):
+        b, x = self._booster(multiclass=True)
+        assert np.array_equal(self._walk(b, x, "pallas"),
+                              self._walk(b, x, "raw"))
+
+    def test_categorical_ensemble_keeps_reference_walk(self):
+        """has_cat ensembles must take the reference walk even under a
+        forced pallas pick (the kernel table is numeric-only) — and still
+        score correctly."""
+        b, x = self._booster(cat=True)
+        assert b._packed_device()["has_cat"]
+        assert np.array_equal(self._walk(b, x, "pallas"),
+                              self._walk(b, x, "raw"))
+
+    def test_auto_resolves_raw_off_tpu(self):
+        import jax
+
+        assert jax.default_backend() != "tpu"
+        b, x = self._booster()
+        # auto == raw bit-for-bit here (they are the same branch on CPU)
+        assert np.array_equal(self._walk(b, x, "auto"),
+                              self._walk(b, x, "raw"))
+
+
+# -- int8 quantization ---------------------------------------------------------
+
+
+class TestInt8Quant:
+    def test_per_channel_codes_and_error_bound(self):
+        from mmlspark_tpu.dnn.quant import dequantize, quantize_per_channel
+
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(64, 32)).astype(np.float32)
+        w[:, 5] = 0.0  # all-zero channel
+        q, scale = quantize_per_channel(w)
+        assert q.dtype == np.int8 and scale.shape == (32,)
+        assert np.abs(q).max() <= 127
+        assert scale[5] == 1.0  # zero channel dequantizes exactly
+        # documented bound: per-weight error <= scale/2 per channel
+        err = np.abs(dequantize(q, scale) - w)
+        assert np.all(err <= scale[None, :] / 2 + 1e-7)
+
+    def test_kernel_matches_xla_factorization(self):
+        from mmlspark_tpu.dnn.quant import int8_matmul, quantize_per_channel
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(48, 200)).astype(np.float32)
+        q, scale = quantize_per_channel(
+            rng.normal(size=(200, 96)).astype(np.float32))
+        got = np.asarray(int8_matmul(x, q, scale))
+        want = (x @ q.astype(np.float32)) * scale[None, :]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_oversized_operand_falls_back_to_xla(self):
+        """Past the VMEM budget the impl IS the XLA factorization — the
+        two paths agree because the fallback is the reference formula."""
+        from mmlspark_tpu.dnn import quant
+
+        rng = np.random.default_rng(2)
+        K, N = 256, 8192  # K_pad*N_pad = 2M > _MM_VMEM_ELEMS (1M)
+        assert K * N > quant._MM_VMEM_ELEMS
+        x = rng.normal(size=(8, K)).astype(np.float32)
+        q, scale = quant.quantize_per_channel(
+            rng.normal(size=(K, N)).astype(np.float32))
+        got = np.asarray(quant.int8_matmul(x, q, scale))
+        want = (x @ q.astype(np.float32)) * scale[None, :]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_quantize_variables_tree_shape(self):
+        from mmlspark_tpu.dnn.quant import quantize_variables
+
+        variables = {
+            "params": {
+                "d0": {"kernel": np.ones((4, 3), np.float32),
+                       "bias": np.zeros(3, np.float32)},
+                "bn0": {"scale": np.ones(3, np.float32)},
+            },
+            "state": {"bn0": {"mean": np.zeros(3, np.float32)}},
+        }
+        out = quantize_variables(variables)
+        d0 = out["params"]["d0"]
+        assert d0["kernel"].dtype == np.int8
+        assert d0["kernel_scale"].shape == (3,)
+        assert d0["bias"].dtype == np.float32  # biases stay f32
+        assert "kernel_scale" not in out["params"]["bn0"]
+        assert out["state"] == variables["state"]  # state untouched
+
+
+# -- checkpoint fingerprints ---------------------------------------------------
+
+
+def _fp(cfg=None, stream=0, hist_impl=None, n=64):
+    x, y = _data(n=n, seed=11)
+    cfg = cfg or TrainConfig(num_iterations=3, verbosity=0)
+    return _gbdt_fingerprint(x, y, OBJ, cfg, None, None, None, None,
+                             stream_chunk_rows=stream, hist_impl=hist_impl)
+
+
+class TestHistImplFingerprints:
+    def test_einsum_keeps_legacy_fingerprint_byte_identical(self):
+        """The back-compat contract: an einsum fit's fingerprint is
+        byte-identical to a pre-PR19 store's (which never saw the field),
+        so every existing checkpoint keeps resuming."""
+        assert _fp(hist_impl="einsum") == _fp(hist_impl=None)
+        assert _fp(stream=300, hist_impl="einsum") == _fp(stream=300)
+
+    def test_pallas_differs_from_einsum_on_every_engine(self):
+        """hist_impl is resolved before engine dispatch and the engine
+        key itself is popped from the ident — so the pallas/einsum split
+        shows on plain, streamed, and (via the same ident) dp fits."""
+        assert _fp(hist_impl="pallas") != _fp(hist_impl="einsum")
+        assert _fp(stream=300, hist_impl="pallas") != _fp(stream=300,
+                                                          hist_impl="einsum")
+
+    def test_cfg_field_itself_is_popped(self):
+        """Only the RESOLVED impl is identity-bearing: a cfg carrying
+        hist_impl='pallas' that resolved to einsum (the auto GSPMD
+        carve-out) must fingerprint as einsum."""
+        cfg_p = TrainConfig(num_iterations=3, verbosity=0,
+                            hist_impl="pallas")
+        cfg_e = TrainConfig(num_iterations=3, verbosity=0,
+                            hist_impl="einsum")
+        assert _fp(cfg=cfg_p, hist_impl="einsum") == _fp(cfg=cfg_e,
+                                                         hist_impl="einsum")
+
+    def test_streamed_fits_keep_pr15_key_name(self, monkeypatch):
+        """Streamed pallas stores written before the per-engine
+        generalization carry `stream_hist_impl`; the generalized emitter
+        must keep that NAME under streaming (so they keep resuming) and
+        use `hist_impl` only for non-streamed fits."""
+        from mmlspark_tpu.io import checkpoint as ckpt_mod
+
+        captured = {}
+        real = ckpt_mod.fingerprint
+
+        def spy(ident, *arrays, **kw):
+            captured.update(ident)
+            return real(ident, *arrays, **kw)
+
+        monkeypatch.setattr(ckpt_mod, "fingerprint", spy)
+
+        captured.clear()
+        _fp(stream=300, hist_impl="pallas")
+        assert captured.get("stream_hist_impl") == "pallas"
+        assert "hist_impl" not in captured
+
+        captured.clear()
+        _fp(hist_impl="pallas")
+        assert captured.get("hist_impl") == "pallas"
+        assert "stream_hist_impl" not in captured
+
+        captured.clear()
+        _fp(hist_impl="einsum")
+        assert "hist_impl" not in captured
+        assert "stream_hist_impl" not in captured
+
+    def test_pallas_store_refuses_einsum_resume(self, tmp_path):
+        """End to end through the checkpoint store: a pallas-grown store
+        must refuse a resume under einsum segments (and a changed impl
+        must refuse rather than silently mix kernels mid-ensemble)."""
+        x, y = _data(n=256, seed=13)
+
+        def run(impl):
+            cfg = TrainConfig(num_iterations=4, num_leaves=7, max_bin=31,
+                              verbosity=0, engine="fused", hist_impl=impl)
+            trainer_mod._FORCE_SINGLE_DEVICE = True
+            try:
+                return train_booster(x, y, OBJ, cfg,
+                                     checkpoint_dir=str(tmp_path / "ck"),
+                                     checkpoint_every=2)
+            finally:
+                trainer_mod._FORCE_SINGLE_DEVICE = False
+
+        run("pallas")
+        with pytest.raises(ValueError, match="fingerprint"):
+            run("einsum")
+
+
+# -- estimator Params ----------------------------------------------------------
+
+
+class TestEstimatorHistImplParam:
+    def test_param_threads_to_train_config(self):
+        from mmlspark_tpu.gbdt import LightGBMClassifier
+
+        est = LightGBMClassifier(hist_impl="einsum")
+        assert est._train_config(2).hist_impl == "einsum"
+        assert LightGBMClassifier()._train_config(2).hist_impl == "auto"
+
+    def test_bad_value_fails_at_fit_entry(self):
+        with pytest.raises(ValueError, match="hist_impl"):
+            x, y = _data(n=128)
+            _fit(x, y, "fused", "metal", single=True)

@@ -587,6 +587,74 @@ class TestBf16Variants:
         assert np.abs(f32 - bf16).mean() / denom < 5e-2
 
 
+# -- int8 inference variants ---------------------------------------------------
+
+
+class TestInt8Variants:
+    def test_zoo_int8_parity_gate(self):
+        """The documented gate, in the bf16 gate's shape: int8 weight-only
+        scoring of a zoo model matches f32 top-1 EXACTLY and relative
+        logit MAE stays under INT8_LOGIT_MAE_TOL. dtype='float32' on the
+        f32 bundle remains the rollback."""
+        from mmlspark_tpu.dnn.zoo_builders import (
+            INT8_LOGIT_MAE_TOL,
+            int8_variant,
+            resnet50_random,
+        )
+        from mmlspark_tpu.models import TPUModel
+
+        bundle = resnet50_random(num_classes=10, input_shape=(32, 32, 3))
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 256, (8, 32 * 32 * 3), dtype=np.uint8)
+        df = DataFrame.from_dict({"features": x})
+
+        # an int8 zoo variant stays int8 through the default (inherit)
+        inherit = TPUModel(
+            int8_variant(bundle), input_col="features", output_col="o"
+        )
+        assert inherit._network_for_eval().compute_dtype == "int8"
+        # dtype="int8" on an f32 bundle quantizes at eval time (cached)
+        quantized = TPUModel(bundle, input_col="features", output_col="o",
+                             dtype="int8")
+        assert quantized._network_for_eval().compute_dtype == "int8"
+
+        f32 = np.asarray(
+            TPUModel(bundle, input_col="features",
+                     output_col="o").transform(df)["o"]
+        )
+        i8 = np.asarray(quantized.transform(df)["o"])
+        assert i8.dtype == np.float32  # activations/output stay f32
+        rel_mae = np.abs(f32 - i8).mean() / np.abs(f32).mean()
+        assert rel_mae < INT8_LOGIT_MAE_TOL, rel_mae
+        assert (f32.argmax(axis=1) == i8.argmax(axis=1)).all()
+
+    def test_int8_variant_quantizes_kernels_only(self):
+        from mmlspark_tpu.dnn.zoo_builders import int8_variant, resnet50_random
+
+        bundle = resnet50_random(num_classes=4, input_shape=(16, 16, 3))
+        twin = int8_variant(bundle)
+        assert twin.network.compute_dtype == "int8"
+        assert twin.variables is not bundle.variables  # codes, not shares
+        assert int8_variant(twin) is twin  # idempotent
+        # every conv/dense kernel is int8 + per-channel scale; BN untouched
+        seen = []
+
+        def walk(tree):
+            for k, v in tree.items():
+                if isinstance(v, dict):
+                    walk(v)
+                elif k == "kernel":
+                    seen.append((np.asarray(v).dtype,
+                                 "kernel_scale" in tree))
+        walk(twin.variables["params"])
+        assert seen and all(dt == np.int8 and has for dt, has in seen)
+        # the builder's dtype kwarg produces the same thing directly
+        direct = resnet50_random(
+            num_classes=4, input_shape=(16, 16, 3), dtype="int8"
+        )
+        assert direct.network.compute_dtype == "int8"
+
+
 # -- serving: the fused path behind the staged handler ------------------------
 
 

@@ -198,14 +198,14 @@ class TestShardedStreaming:
         cfg = TrainConfig(verbosity=0)
         einsum_fp = _gbdt_fingerprint(
             x, y, obj, cfg, None, None, None, None,
-            stream_chunk_rows=128, stream_hist_impl="einsum",
+            stream_chunk_rows=128, hist_impl="einsum",
         )
         legacy_fp = _gbdt_fingerprint(
             x, y, obj, cfg, None, None, None, None, stream_chunk_rows=128,
         )
         pallas_fp = _gbdt_fingerprint(
             x, y, obj, cfg, None, None, None, None,
-            stream_chunk_rows=128, stream_hist_impl="pallas",
+            stream_chunk_rows=128, hist_impl="pallas",
         )
         assert einsum_fp == legacy_fp  # einsum stores stay resumable
         assert pallas_fp != einsum_fp

@@ -141,6 +141,51 @@ def test_host_sync_rule_ignores_non_transform_functions():
     assert all(f.line < fit_line for f in findings)
 
 
+# -- kernel fallback ----------------------------------------------------------
+
+
+def test_kernel_without_fallback_fires_and_suppresses():
+    from mmlspark_tpu.analysis.kernel_fallback import check_kernel_fallback
+
+    path = os.path.join(FIXTURES, "kernel_bad.py")
+    findings = check_kernel_fallback([path], repo_root=FIXTURES)
+    _assert_matches_markers("kernel_bad.py", findings)
+
+
+def test_kernel_rule_accepts_every_fallback_shape():
+    """The three clean variants in the fixture (interpret kwarg, interpret
+    parameter, *_impl dispatch beside einsum) must all pass — they are the
+    exact shapes the real kernels in gbdt/compute.py and dnn/quant.py use."""
+    from mmlspark_tpu.analysis.kernel_fallback import check_kernel_fallback
+
+    path = os.path.join(FIXTURES, "kernel_bad.py")
+    findings = check_kernel_fallback([path], repo_root=FIXTURES)
+    with open(path) as f:
+        src = f.read()
+    for clean_fn in ("good_interpret_kwarg", "good_interpret_param",
+                     "good_impl_dispatch"):
+        assert clean_fn in src  # fixture lost a clean variant
+    flagged_lines = {f.line for f in findings}
+    bad_lines = {
+        i for i, line in enumerate(src.splitlines(), start=1)
+        if "expect[kernel-without-fallback]" in line
+        or "expect-suppressed[kernel-without-fallback]" in line
+    }
+    assert flagged_lines == bad_lines, findings
+
+
+def test_kernel_rule_package_scan_clean():
+    """Every real pallas_call in the package keeps its fallback arm — the
+    scan over the kernel tier's actual modules finds nothing."""
+    from mmlspark_tpu.analysis.kernel_fallback import check_kernel_fallback
+
+    paths = [
+        os.path.join(REPO, "mmlspark_tpu", "gbdt", "compute.py"),
+        os.path.join(REPO, "mmlspark_tpu", "dnn", "quant.py"),
+    ]
+    assert check_kernel_fallback(paths, repo_root=REPO) == []
+
+
 # -- batch loop ---------------------------------------------------------------
 
 

@@ -103,7 +103,7 @@ def test_device_walk_against_host_reference_at_scale():
     packed = b._pack()
     # _walk_device directly: _walk_all would silently fall back to the host
     # walk on a detected mismatch, making this test pass vacuously
-    dev = b._walk_device(xt, packed)
+    dev = np.asarray(b._walk_device(xt))
     ref = b._walk_numpy(xt[:512], packed)
     np.testing.assert_allclose(dev[:512], ref, rtol=1e-5, atol=1e-6)
 
@@ -144,3 +144,131 @@ def test_streamed_fit_routes_pallas_and_matches_einsum():
     for a, b in zip(bp.trees, be.trees):
         assert a.split_feature == b.split_feature
         np.testing.assert_allclose(a.leaf_value, b.leaf_value, rtol=1e-6)
+
+
+# -- ISSUE 19: the Pallas-by-default compute tier on real hardware -------------
+
+
+def test_auto_hist_impl_resolves_pallas_on_every_engine():
+    """On a TPU backend `hist_impl="auto"` must pick the kernel tier for
+    the per-device engines unconditionally, and for the fused engine
+    except under the multi-device GSPMD carve-out; `"einsum"` stays the
+    explicit rollback everywhere."""
+    import jax
+
+    from mmlspark_tpu.gbdt.trainer import TrainConfig, _resolve_hist_impl
+
+    auto = TrainConfig(hist_impl="auto")
+    assert _resolve_hist_impl(auto, "data_parallel") == "pallas"
+    expected_fused = "einsum" if jax.device_count() > 1 else "pallas"
+    assert _resolve_hist_impl(auto, "fused") == expected_fused
+    rollback = TrainConfig(hist_impl="einsum")
+    for engine in ("fused", "data_parallel"):
+        assert _resolve_hist_impl(rollback, engine) == "einsum"
+
+
+def test_split_finder_kernel_compiled_matches_reference():
+    """The Pallas split finder COMPILED for the MXU (not interpret mode)
+    must make decisions identical to the jitted-vmap reference."""
+    from mmlspark_tpu.gbdt.compute import best_splits_for_hists
+
+    rng = np.random.default_rng(4)
+    m, f, b = 15, 64, 64
+    cnt = rng.integers(1, 60, size=(m, f, b)).astype(np.float32)
+    hists = np.stack([
+        rng.normal(size=(m, f, b)).astype(np.float32) * cnt,
+        rng.uniform(0.1, 1.0, size=(m, f, b)).astype(np.float32) * cnt,
+        cnt,
+    ], axis=-1)
+    cat = tuple([False] * f)
+
+    def find(impl):
+        out = best_splits_for_hists(
+            hists, True, np.full(f, b, np.int32), np.zeros(f, bool),
+            np.ones(f, bool), np.float32(1.0), np.float32(1e-3),
+            np.float32(0.0), np.float32(1.0),
+            num_bins=b, max_cat_threshold=8, cat_static=cat,
+            split_impl=impl,
+        )
+        return [np.asarray(a) for a in out]
+
+    ref, ker = find("reference"), find("pallas")
+    np.testing.assert_array_equal(ref[1], ker[1])
+    np.testing.assert_array_equal(ref[2], ker[2])
+    np.testing.assert_allclose(ref[0], ker[0], rtol=1e-5, atol=1e-5)
+
+
+def test_scoring_kernel_compiled_bitwise_vs_reference_walk():
+    """auto scoring on TPU takes the fused Pallas walk; it must match the
+    reference walk bit for bit (one-hot MXU gathers are exact selects)."""
+    import jax
+
+    from mmlspark_tpu.gbdt.objectives import make_objective
+    from mmlspark_tpu.gbdt.trainer import TrainConfig, train_booster
+
+    rng = np.random.default_rng(5)
+    n, f = 8_192, 12
+    x = rng.normal(size=(n, f))
+    y = (x[:, 0] + 0.4 * x[:, 1] > 0).astype(np.float64)
+    b = train_booster(x, y, make_objective("binary", num_class=2),
+                      TrainConfig(num_iterations=5, num_leaves=15,
+                                  verbosity=0))
+    xt = x.astype(np.float32)
+    xt[::5, 0] = np.nan  # NaN routing must agree too
+    assert jax.default_backend() == "tpu"
+    b._walk_impl = "pallas"
+    kernel = np.asarray(b.predict_raw(xt))
+    b._walk_impl = "raw"
+    raw = np.asarray(b.predict_raw(xt))
+    b._walk_impl = "auto"
+    assert np.array_equal(kernel, raw)
+
+
+def test_hist_pass_mfu_attributable_and_no_worse_than_einsum():
+    """The documented on-device MFU gate (BENCH_pr19.json records it as
+    TPU-only): fit once per impl, read the per-round flight records'
+    hist_impl attrs back, and assert the pallas arm's analytic-FLOPs MFU
+    is no worse than the einsum arm's on the same fit shape."""
+    from mmlspark_tpu.gbdt.objectives import make_objective
+    from mmlspark_tpu.gbdt.trainer import TrainConfig, train_booster
+    from mmlspark_tpu.obs.profiler import device_profiler
+
+    rng = np.random.default_rng(6)
+    n, f = 65_536, 24
+    x = rng.normal(size=(n, f))
+    y = (x[:, 0] > 0).astype(np.float64)
+    obj = make_objective("binary", num_class=2)
+
+    def device_s_and_flops(impl):
+        cfg = TrainConfig(num_iterations=4, num_leaves=15, verbosity=0,
+                          engine="data_parallel", hist_impl=impl)
+        train_booster(x, y, obj, cfg)  # warm: compile outside the measure
+        before = device_profiler().flight()["total_records"]
+        train_booster(x, y, obj, cfg)
+        recs = device_profiler().flight()["records"]
+        mine = [r for r in recs
+                if (r.get("attrs") or {}).get("hist_impl") == impl
+                and r.get("flops_source") == "analytic"]
+        assert mine, f"no attributable flight rows for {impl}"
+        assert device_profiler().flight()["total_records"] > before
+        return (sum(r["device_s"] for r in mine),
+                sum(r["flops"] for r in mine))
+
+    s_pallas, fl_pallas = device_s_and_flops("pallas")
+    s_einsum, fl_einsum = device_s_and_flops("einsum")
+    # same fit shape -> same analytic flops; MFU ordering reduces to wall
+    mfu_pallas = fl_pallas / max(s_pallas, 1e-9)
+    mfu_einsum = fl_einsum / max(s_einsum, 1e-9)
+    assert mfu_pallas >= mfu_einsum * 0.95, (mfu_pallas, mfu_einsum)
+
+
+def test_int8_matmul_kernel_compiled_matches_xla():
+    from mmlspark_tpu.dnn.quant import int8_matmul, quantize_per_channel
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(512, 256)).astype(np.float32)
+    q, scale = quantize_per_channel(
+        rng.normal(size=(256, 128)).astype(np.float32))
+    got = np.asarray(int8_matmul(x, q, scale, interpret=False))
+    want = (x @ q.astype(np.float32)) * scale[None, :]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
