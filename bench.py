@@ -330,6 +330,29 @@ def _gate_pr19(r):
     )
 
 
+def _gate_pr20(r):
+    f = r["federation"]
+    rec, slo = f["reconciliation"], f["cluster_slo"]
+    mem, kill, ov = f["memory_scope"], f["kill"], f["overhead"]
+    return (
+        rec["exact"]
+        and rec["completed_requests"] > 0
+        and slo["burst_500s"] >= 8
+        and slo["alert_fired"]
+        and slo["healthz_degraded"]
+        and slo["cluster_slos_served"]
+        and mem["zero_drift"]
+        and mem["errors"] == 0
+        and len(mem["procs"]) >= 1
+        and kill["partial_errors"] >= 1
+        and kill["procs_still_served"] >= 1
+        and kill["scrape_failures_total"] >= 1
+        and kill["staleness_rising"]
+        and kill["scrape_stale_flagged"]
+        and ov["overhead_frac"] <= 0.05
+    )
+
+
 #: artifact basename -> that bench's own tier-1 gate (the clobber guard)
 _BENCH_GATES = {
     "BENCH_pr03.json": _gate_pr03,
@@ -345,6 +368,7 @@ _BENCH_GATES = {
     "BENCH_pr16.json": _gate_pr16,
     "BENCH_pr18.json": _gate_pr18,
     "BENCH_pr19.json": _gate_pr19,
+    "BENCH_pr20.json": _gate_pr20,
 }
 
 def peak_flops() -> float:
@@ -3242,6 +3266,353 @@ def run_memory_smoke(out_path: str = "BENCH_pr16.json") -> dict:
     return _write_report(report, out_path)
 
 
+def run_federation_smoke(out_path: str = "BENCH_pr20.json") -> dict:
+    """Observability-federation smoke bench (CPU-safe; wired into tier-1
+    via tests/test_bench_smoke.py), written to BENCH_pr20.json. ISSUE 20
+    acceptance, through the product path (no mocks):
+
+    - **reconciliation**: a 4-worker closed loop, then EXACT equality
+      between (a) the federated ``proc="cluster"``
+      `serving_request_latency_ms_count` sum over worker engines on the
+      gateway's /metrics, (b) the sum of the same series read directly
+      off each worker's own /metrics, and (c) the number of requests the
+      clients actually completed — federation neither loses nor
+      double-counts a single request.
+    - **cluster_slo**: an `SLOSpec` registered at the gateway on the
+      CLUSTER engine label (`srv.cluster_engine`) — an engine no request
+      ever carries directly; only the federation scrape feed populates
+      it — fires its fast-window page alert after an injected
+      worker-side error burst, and flips the gateway /healthz to
+      degraded, from federated data alone.
+    - **memory_scope**: ``GET /debug/memory?scope=cluster`` attributes
+      every proc's resident bytes with zero drift (per-class sums equal
+      the ledger total; the truth-check reports no drifted devices).
+    - **kill**: killing one worker mid-run yields PARTIAL cluster debug
+      results (an explicit per-worker error entry, no hang), increments
+      `obs_federation_scrape_failures_total` for that worker, its
+      staleness gauge rises between two reads, and the router snapshot
+      flags it `scrape_stale` once past the staleness budget.
+    - **overhead**: the whole federation plane (background scrapes +
+      merged re-export + SLO feed) costs <= 5% closed-loop serving
+      throughput, measured as paired alternating segments on ONE pool
+      with the scrape loop running vs stopped (median per arm) — the
+      paired design cancels the pool-startup scheduling noise that
+      dwarfs a 5% bound when each arm gets its own pool.
+    """
+    import http.client
+
+    import jax
+
+    from mmlspark_tpu.core.dataframe import DataType
+    from mmlspark_tpu.obs.federation import FederationConfig
+    from mmlspark_tpu.obs.metrics import parse_prometheus
+    from mmlspark_tpu.obs.metrics import registry as obs_reg
+    from mmlspark_tpu.obs.slo import BurnWindow, SLOSpec, slo_monitor
+    from mmlspark_tpu.serving import (
+        DistributedServingServer,
+        FabricConfig,
+        FaultInjector,
+        make_reply,
+        parse_request,
+    )
+
+    PER_ROW_S = 2e-3
+    N_CLIENTS = 4
+    N_REQUESTS = 20
+
+    def echo_factory():
+        def handler(df):
+            parsed = parse_request(df, {"x": None})
+            vals = []
+            for v in parsed["x"]:
+                if v == "boom":  # worker-side error burst trigger
+                    raise RuntimeError("injected worker error")
+                vals.append(float(v) * 2.0)
+            time.sleep(PER_ROW_S * len(df))
+            return make_reply(
+                parsed.with_column(
+                    "y", np.asarray(vals, np.float64), DataType.DOUBLE
+                ),
+                "y",
+            )
+        return handler
+
+    def http_get(port, route):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", route)
+        r = conn.getresponse()
+        body = r.read()
+        conn.close()
+        return r.status, body
+
+    def post(port, api, payload):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", f"/{api}", json.dumps(payload).encode(),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        r.read()
+        conn.close()
+        return r.status
+
+    fast_fabric = FabricConfig(
+        failure_threshold=3, open_secs=0.3, health_interval_s=0.05,
+        backoff_base_ms=1.0, backoff_max_ms=4.0,
+    )
+    fed_cfg = FederationConfig(scrape_interval_s=0.1)
+    monitor = slo_monitor()
+
+    def serving_counts(text, engines):
+        """Sum of serving_request_latency_ms_count over `engines`,
+        restricted (for federated text) to proc="cluster" series."""
+        total = 0.0
+        for (name, labels), v in parse_prometheus(text).items():
+            if name != "serving_request_latency_ms_count":
+                continue
+            lab = dict(labels)
+            if "proc" in lab and lab["proc"] != "cluster":
+                continue
+            if lab.get("engine") in engines:
+                total += v
+        return total
+
+    # -- (1-4) one 4-worker pool: load, reconcile, burn, kill ----------------
+    faults = FaultInjector()
+    fastw = BurnWindow("fast", short_s=1.5, long_s=6.0,
+                       burn_threshold=2.0, severity="page")
+    alerts_fam = obs_reg().counter(
+        "slo_burn_alerts_total",
+        "Multi-window burn-rate alert activations per SLO",
+        ("slo", "window"),
+    )
+    prev_interval = monitor.eval_interval_s
+    spec_name = None
+    try:
+        with DistributedServingServer(
+            echo_factory, n_workers=4, api_name="fedsmoke",
+            fabric=fast_fabric, worker_timeout=5.0,
+            fault_injector=faults, federation=fed_cfg,
+        ) as srv:
+            monitor.eval_interval_s = 0.05
+            spec = SLOSpec(
+                "cluster_availability", objective="availability",
+                target=0.95, engine=srv.cluster_engine,
+                windows=(fastw,), min_events=8,
+            )
+            monitor.register(spec)
+            spec_name = spec.name
+            alerts_before = alerts_fam.labels(
+                slo=spec.name, window="fast"
+            ).value()
+
+            wall, lat = _closed_loop_load(
+                srv.port, "/fedsmoke", N_CLIENTS, N_REQUESTS,
+                lambda cid: json.dumps({"x": float(cid)}).encode(),
+                errors_tag="federation smoke",
+            )
+            # quiesce, then read the gateway's federated view (the GET
+            # itself refreshes due scrape targets) and every worker's own
+            # exposition; traffic has stopped, so the three tallies must
+            # agree EXACTLY
+            worker_engines = {w._obs_label for w in srv.workers}
+            time.sleep(fed_cfg.scrape_interval_s + 0.1)
+            code, fed_body = http_get(srv.port, "/metrics")
+            assert code == 200, code
+            cluster_sum = serving_counts(fed_body.decode(), worker_engines)
+            direct_sum = 0.0
+            for w in srv.workers:
+                wcode, wbody = http_get(w.port, "/metrics")
+                assert wcode == 200, wcode
+                direct_sum += serving_counts(
+                    wbody.decode(), {w._obs_label}
+                )
+            reconciliation = {
+                "clients": N_CLIENTS,
+                "requests_per_client": N_REQUESTS,
+                "completed_requests": len(lat),
+                "cluster_sum": cluster_sum,
+                "worker_direct_sum": direct_sum,
+                "exact": (
+                    cluster_sum == direct_sum == float(len(lat))
+                ),
+            }
+
+            # worker-side error burst -> the CLUSTER spec (an engine only
+            # the federation feed ever populates) pages at the gateway
+            burst = [post(srv.port, "fedsmoke", {"x": "boom"})
+                     for _ in range(24)]
+            time.sleep(fed_cfg.scrape_interval_s + 0.05)
+            http_get(srv.port, "/metrics")  # force a scrape -> SLO feed
+            status_after = monitor.evaluate()
+            _hcode, hbody = http_get(srv.port, "/healthz")
+            health = json.loads(hbody)
+            cluster_slo = {
+                "engine": srv.cluster_engine,
+                "burst_500s": sum(1 for s in burst if s >= 500),
+                "alert_fired": (
+                    alerts_fam.labels(slo=spec.name, window="fast").value()
+                    - alerts_before
+                ) >= 1,
+                "burn_status": status_after.get(spec.name, {}).get(
+                    "alerts", {}
+                ).get("fast", {}).get("active"),
+                "healthz_degraded": health["status"] == "degraded",
+                "cluster_slos_served": spec.name in (
+                    health.get("cluster_slos") or {}
+                ),
+            }
+
+            # cluster-scope memory debug: per-proc attribution, zero drift
+            _mcode, mbody = http_get(
+                srv.port, "/debug/memory?scope=cluster"
+            )
+            mem = json.loads(mbody)
+            drift_free = True
+            for payload in mem["procs"].values():
+                by_dev = payload["resident"]
+                class_sum = sum(
+                    b for dev in by_dev.values() for b in dev.values()
+                )
+                if class_sum != payload["total_bytes"]:
+                    drift_free = False
+                rec = payload.get("reconcile") or {}
+                if rec.get("drifted"):
+                    drift_free = False
+            memory_scope = {
+                "procs": sorted(mem["procs"]),
+                "errors": len(mem["errors"]),
+                "zero_drift": drift_free and mem["errors"] == [],
+            }
+
+            # kill one worker: partial debug results, failure counter,
+            # rising staleness, router scrape_stale flag
+            faults.kill_worker(srv, 0)
+            time.sleep(fed_cfg.scrape_interval_s + 0.05)
+            http_get(srv.port, "/metrics")  # scrape round hits the corpse
+            _c1, h1 = http_get(srv.port, "/healthz")
+            stale_1 = json.loads(h1)["federation"]["targets"]["worker-0"]
+            _fcode, fbody = http_get(
+                srv.port, "/debug/flight?scope=cluster"
+            )
+            flight = json.loads(fbody)
+            # past the staleness budget the router view flags the worker
+            time.sleep(
+                fed_cfg.stale_after_intervals * fed_cfg.scrape_interval_s
+                + 0.15
+            )
+            http_get(srv.port, "/metrics")
+            _c2, h2 = http_get(srv.port, "/healthz")
+            health2 = json.loads(h2)
+            stale_2 = health2["federation"]["targets"]["worker-0"]
+            router_w0 = next(
+                w for w in health2["router"]["workers"] if w["idx"] == 0
+            )
+            fail_total = sum(
+                v for (name, labels), v in parse_prometheus(
+                    http_get(srv.port, "/metrics")[1].decode()
+                ).items()
+                if name == "obs_federation_scrape_failures_total"
+                and dict(labels).get("worker") == "worker-0"
+                and dict(labels).get("proc") == "cluster"
+            )
+            kill = {
+                "partial_errors": len(flight["errors"]),
+                "procs_still_served": len(flight["procs"]),
+                "scrape_failures_total": fail_total,
+                "staleness_first_s": stale_1["staleness_s"],
+                "staleness_later_s": stale_2["staleness_s"],
+                "staleness_rising": (
+                    stale_2["staleness_s"] > stale_1["staleness_s"] > 0.0
+                ),
+                "scrape_stale_flagged": bool(router_w0["scrape_stale"]),
+            }
+    finally:
+        monitor.eval_interval_s = prev_interval
+        if spec_name is not None:
+            monitor.unregister(spec_name)
+
+    # -- (5) federation overhead: paired same-pool arms ----------------------
+    # The two arms share ONE 4-worker pool and alternate short segments
+    # with the federation scrape loop running ("on") vs stopped ("off").
+    # Separate pools per arm proved unmeasurable on a shared box: pool
+    # startup scheduling alone swings closed-loop throughput far more
+    # than the <=5% bound under test. Pairing the arms on the same pool
+    # cancels that noise; the median over 5 segments per arm absorbs any
+    # single scheduler stall. Every "on" segment forces a scrape round
+    # at its start (plus the 0.5s background cadence — 4x the deployed
+    # default of 2s), so the plane is demonstrably active inside every
+    # measured "on" window.
+    N_OVERHEAD_REQS = 75  # per client per segment (~300 reqs/segment)
+    N_OVERHEAD_PAIRS = 5
+
+    def _segment():
+        wall, lat = _closed_loop_load(
+            srv.port, "/fedov", N_CLIENTS, N_OVERHEAD_REQS,
+            lambda cid: json.dumps({"x": float(cid)}).encode(),
+            errors_tag="federation overhead",
+        )
+        return {
+            "throughput_rps": round(N_CLIENTS * N_OVERHEAD_REQS / wall, 1),
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+            "p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 3),
+            "wall_s": round(wall, 3),
+        }
+
+    with DistributedServingServer(
+        echo_factory, n_workers=4, api_name="fedov", fabric=fast_fabric,
+        worker_timeout=5.0,
+        federation=FederationConfig(scrape_interval_s=0.5),
+    ) as srv:
+        assert srv.federator is not None
+        _closed_loop_load(
+            srv.port, "/fedov", N_CLIENTS, 5,
+            lambda cid: json.dumps({"x": float(cid)}).encode(),
+            errors_tag="federation overhead warm",
+        )
+        # absorb the one full-exposition round; in-process workers are
+        # identity-probed from here on (the steady-state scrape cost)
+        srv.federator.scrape_all(force=True)
+        on_segments, off_segments = [], []
+        for _ in range(N_OVERHEAD_PAIRS):
+            srv.federator.start()
+            srv.federator.scrape_all(force=True)
+            on_segments.append(_segment())
+            srv.federator.stop()
+            off_segments.append(_segment())
+        srv.federator.start()
+        http_get(srv.port, "/metrics")  # federated view still serves
+
+    def _median_rps(segments):
+        rps = sorted(s["throughput_rps"] for s in segments)
+        return rps[len(rps) // 2]
+
+    enabled_best = max(on_segments, key=lambda s: s["throughput_rps"])
+    disabled_best = max(off_segments, key=lambda s: s["throughput_rps"])
+    ratio = _median_rps(on_segments) / _median_rps(off_segments)
+
+    report = {
+        "pr": 20,
+        "platform": jax.default_backend(),
+        "federation": {
+            "scrape_interval_s": fed_cfg.scrape_interval_s,
+            "n_workers": 4,
+            "reconciliation": reconciliation,
+            "cluster_slo": cluster_slo,
+            "memory_scope": memory_scope,
+            "kill": kill,
+            "overhead": {
+                "enabled": enabled_best,
+                "disabled": disabled_best,
+                "enabled_median_rps": _median_rps(on_segments),
+                "disabled_median_rps": _median_rps(off_segments),
+                "n_segment_pairs": N_OVERHEAD_PAIRS,
+                "throughput_ratio": round(ratio, 4),
+                "overhead_frac": round(max(0.0, 1.0 - ratio), 4),
+            },
+        },
+    }
+    return _write_report(report, out_path)
+
+
 def run_dnn_training_smoke(out_path: str = "BENCH_pr18.json") -> dict:
     """Pipelined DNN training smoke bench (CPU-safe; wired into tier-1 via
     tests/test_bench_smoke.py::test_dnn_training_smoke_gates). ISSUE 18
@@ -3906,6 +4277,7 @@ if __name__ == "__main__":
         print(json.dumps(run_slo_trace_smoke(), sort_keys=True))
         print(json.dumps(run_sharded_gbdt_smoke(), sort_keys=True))
         print(json.dumps(run_memory_smoke(), sort_keys=True))
+        print(json.dumps(run_federation_smoke(), sort_keys=True))
         print(json.dumps(run_dnn_training_smoke(), sort_keys=True))
         print(json.dumps(run_compute_tier_smoke(), sort_keys=True))
         sys.exit(0)

@@ -50,6 +50,8 @@ RULES: Dict[str, str] = {
     "per-step-host-sync-in-train-loop": "float()/.item()/np.asarray()/block_until_ready() on a jitted step's result inside a fit*/train* for-loop serializes async dispatch; accumulate device scalars and device_get once per epoch",
     # kernel-fallback family (kernel_fallback.py)
     "kernel-without-fallback": "pallas_call whose enclosing function shows no interpret= path, no interpret parameter, and no *_impl/einsum dispatch arm; the kernel is TPU-only, untested by tier-1 CPU CI, and has no rollback lever",
+    # metric-docs family (metric_docs.py)
+    "undocumented-metric-family": "counter/gauge/histogram registration whose family name is absent from docs/observability.md's metric tables; an instrument only code knows about is the series an operator meets mid-incident with no contract",
     # Params-contract family (params_contract.py)
     "param-converter": "simple Param declared without an explicit type converter",
     "param-doc": "stage or Param missing documentation",

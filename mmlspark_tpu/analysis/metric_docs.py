@@ -1,0 +1,118 @@
+"""Metric-docs rule: every registered metric family must be documented.
+
+`undocumented-metric-family` flags a ``.counter("name", ...)`` /
+``.gauge(...)`` / ``.histogram(...)`` registration whose family name does
+not appear in docs/observability.md's metric tables. The tables are the
+operator contract — dashboards, alerts and the federation merge semantics
+are all written against them — and an instrument that exists only in code
+is exactly the series an operator discovers mid-incident with no idea
+what it measures or which labels it carries.
+
+Documented names are harvested from MARKDOWN TABLE ROWS only (lines
+starting with ``|``), from backtick spans: a trailing ``{label,...}``
+group is the label set and is dropped (``serving_request_latency_ms
+{engine,code}`` documents ``serving_request_latency_ms``); an interior
+brace group is alternation and expands (``dataplane_{h2d,d2h}_bytes_total``
+documents both families), matching how the existing tables are written.
+Prose mentions outside tables do NOT count — the point is the table row
+with the source/meaning column, not a name-drop.
+
+A deliberately internal family takes a justified
+``# graftcheck: ignore[undocumented-metric-family]`` on the registration
+line; none exists today.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+import os
+import re
+from typing import Iterable, List, Set
+
+from mmlspark_tpu.analysis.base import Finding
+
+_RULE = "undocumented-metric-family"
+_DOC_REL = os.path.join("docs", "observability.md")
+_REGISTER_METHODS = ("counter", "gauge", "histogram")
+#: what a Prometheus family name (possibly with doc-table brace groups)
+#: looks like; anything else in backticks is code, not a metric
+_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_DOC_TOKEN_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_{},]*$")
+_TRAILING_LABELS_RE = re.compile(r"\{[^{}]*\}$")
+_ALTERNATION_RE = re.compile(r"\{([^{}]*)\}")
+
+
+def _expand_alternation(token: str) -> Iterable[str]:
+    """``a_{b,c}_d`` -> ``a_b_d``, ``a_c_d`` (recursively, leftmost-first)."""
+    m = _ALTERNATION_RE.search(token)
+    if m is None:
+        return (token,)
+    return itertools.chain.from_iterable(
+        _expand_alternation(token[: m.start()] + alt + token[m.end():])
+        for alt in m.group(1).split(",")
+    )
+
+
+def documented_families(doc_source: str) -> Set[str]:
+    """Family names the doc's metric tables declare."""
+    names: Set[str] = set()
+    for line in doc_source.splitlines():
+        if not line.lstrip().startswith("|"):
+            continue
+        for token in re.findall(r"`([^`]+)`", line):
+            token = token.strip()
+            if not _DOC_TOKEN_RE.match(token):
+                continue
+            token = _TRAILING_LABELS_RE.sub("", token)
+            for name in _expand_alternation(token):
+                if _NAME_RE.match(name):
+                    names.add(name)
+    return names
+
+
+def _registrations(tree: ast.AST) -> Iterable[ast.Call]:
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _REGISTER_METHODS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            yield node
+
+
+def check_metric_docs(
+    paths: Iterable[str], repo_root: str, doc_rel: str = _DOC_REL
+) -> List[Finding]:
+    doc_path = os.path.join(repo_root, doc_rel)
+    try:
+        with open(doc_path) as f:
+            documented = documented_families(f.read())
+    except OSError:
+        # no doc at all: every registration is by definition undocumented
+        documented = set()
+    findings: List[Finding] = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                source = f.read()
+            tree = ast.parse(source)
+        except (OSError, SyntaxError):
+            continue
+        rel = os.path.relpath(path, repo_root)
+        for call in _registrations(tree):
+            name = call.args[0].value
+            if not _NAME_RE.match(name):
+                continue  # dynamic/derived names are not family literals
+            if name in documented:
+                continue
+            findings.append(Finding(
+                _RULE, rel, call.lineno,
+                f"metric family {name!r} is registered here but absent "
+                f"from {doc_rel}'s metric tables — document its meaning "
+                "and labels, or justify an inline ignore",
+            ))
+    return findings

@@ -241,6 +241,13 @@ def run_all(
             ],
             repo_root=root,
         )
+    if "undocumented-metric-family" in enabled:
+        from mmlspark_tpu.analysis.metric_docs import check_metric_docs
+
+        # the whole library tier: a metric family is a public operator
+        # contract no matter which module registers it, and the doc tables
+        # (docs/observability.md) are where that contract lives
+        findings += check_metric_docs(package_files, repo_root=root)
     if "unstructured-log-in-library" in enabled:
         from mmlspark_tpu.analysis.unstructured_log import (
             check_unstructured_log,

@@ -39,6 +39,14 @@ One subsystem every layer reports into, scrapeable over HTTP
 - **Structured logging** (`obs.logging`): JSON-lines log records stamped
   with the active span's trace/span ids — the library's only log emitter
   (pinned by graftcheck's `unstructured-log-in-library` rule).
+- **Federation** (`obs.federation`): the cross-process plane — a gateway
+  `Federator` scrapes each worker's ``GET /metrics``, merges (counters
+  sum reset-corrected, gauges pass through, histogram sketches merge)
+  and re-exports under ``proc`` labels with cluster aggregates, fans
+  ``/debug/*`` out with ``?scope=cluster``, stitches cross-process trace
+  trees, and replays worker request outcomes into the SLO monitor under
+  a cluster engine label — with its own scrape health telemetry
+  (``obs_federation_*``) and per-worker staleness feeding the router.
 
 `set_enabled(False)` turns the whole layer off (metrics AND tracing) — the
 rollback lever the overhead smoke bench (bench.run_obs_overhead_smoke,
@@ -50,6 +58,13 @@ from __future__ import annotations
 import contextlib
 from typing import Iterator
 
+from mmlspark_tpu.obs.federation import (
+    FederationConfig,
+    Federator,
+    proc_identity,
+    scrape_payload,
+    set_proc_label,
+)
 from mmlspark_tpu.obs.logging import StructuredLogger, get_logger
 from mmlspark_tpu.obs.memory import (
     CLASSES,
@@ -80,6 +95,7 @@ from mmlspark_tpu.obs.tracing import (
     extract_context,
     format_traceparent,
     inject_context,
+    stitch_trace_trees,
     tracer,
 )
 
@@ -98,7 +114,13 @@ __all__ = [
     "extract_context",
     "format_traceparent",
     "inject_context",
+    "stitch_trace_trees",
     "tracer",
+    "FederationConfig",
+    "Federator",
+    "proc_identity",
+    "scrape_payload",
+    "set_proc_label",
     "BurnWindow",
     "SLOMonitor",
     "SLOSpec",

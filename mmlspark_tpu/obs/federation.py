@@ -1,0 +1,986 @@
+"""Cross-process observability federation: scrape, merge, re-export.
+
+Every obs surface built so far — MetricsRegistry, the Tracer ring, the
+flight recorder, the device-memory ledger, the SLO monitor — is a
+process-local singleton. That is fine while `DistributedServingServer`
+workers share the gateway's process, and silently blind the moment they
+become real subprocesses (the ROADMAP's process-isolation item). This
+module is the bridge, built over the existing HTTP wire protocol so the
+isolation PR can land without touching observability again:
+
+- **Metrics federation** (`Federator`): the gateway scrapes each worker's
+  ``GET /metrics?sketches=1`` on `scrape_interval_s`, parses the classic
+  exposition with `parse_prometheus`, and re-exports the union with a
+  `proc` label per source (``proc="gateway"`` / ``proc="worker-<i>"``)
+  plus cluster-aggregate series under ``proc="cluster"``. Merge semantics
+  per metric type (docs/observability.md "Federation"): counters sum
+  (reset-corrected, so a worker restart never makes a merged counter go
+  backwards), gauges pass through labelled, summaries pass quantiles
+  through per-proc and recombine honest cluster quantiles by merging the
+  serialized `QuantileSketch` state the ``?sketches=1`` payload carries —
+  the text exposition alone ships quantile VALUES, which cannot be merged.
+- **Process identity**: `proc_identity()` stamps payloads with
+  (proc, pid, start_time). Sources whose identity matches are the SAME
+  process registry seen twice (today's in-process workers), so federation
+  dedupes by identity before merging — no double counting now, and the
+  same code is automatically correct when identities diverge.
+- **Cluster SLOs**: on each scrape round the federator diffs every
+  worker-side `serving_request_latency_ms` count/sum series and feeds the
+  deltas into the local `SLOMonitor` under a cluster engine label, so an
+  `SLOSpec(engine=<cluster label>)` registered AT THE GATEWAY burns on
+  worker-side errors it never forwarded — federated data alone.
+- **Federation health telemetry**: `obs_federation_scrape_seconds{worker}`,
+  `obs_federation_scrape_failures_total{worker,kind}`, and a scrape-time
+  `obs_federation_staleness_seconds{worker}` gauge, plus a structured
+  ``federation_scrape_failed`` warning; `is_stale()` feeds the router's
+  health view (a worker unscrapeable for `stale_after_intervals` scrape
+  intervals is suspect even if its socket still accepts).
+
+Everything is clock-injectable and passive by default — `scrape_all()` is
+driven either by the optional background thread (`start()`/`stop()`) or
+lazily at exposition time, and unit tests drive it directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from mmlspark_tpu.obs.logging import get_logger
+from mmlspark_tpu.obs.metrics import (
+    EXEMPLAR_CONTENT_TYPE,
+    MetricsRegistry,
+    QuantileSketch,
+    _escape_label,
+    _format_value,
+    parse_prometheus,
+    registry as obs_registry,
+)
+
+log = get_logger("mmlspark_tpu.obs")
+
+__all__ = [
+    "FederationConfig",
+    "Federator",
+    "proc_identity",
+    "set_proc_label",
+    "identity_key",
+    "scrape_payload",
+]
+
+#: wall-clock process start, anchored at import — with the pid it uniquely
+#: names one OS process incarnation (a recycled pid won't recycle the pair)
+_PROC_START = time.time()
+_PROC_LABEL: Optional[str] = None
+_PROC_LOCK = threading.Lock()
+
+
+def set_proc_label(label: Optional[str]) -> None:
+    """Name this process for debug payloads (``"worker-3"`` in a real
+    subprocess worker). Defaults to ``pid-<pid>`` when unset."""
+    global _PROC_LABEL
+    with _PROC_LOCK:
+        _PROC_LABEL = label
+
+
+def proc_identity() -> Dict[str, Any]:
+    """The process-identity stamp every /debug/flight and /debug/memory
+    payload (and federation scrape payload) carries: which process said
+    this. `start_time` disambiguates pid recycling and lets the federation
+    layer detect a restarted worker behind a stable address."""
+    with _PROC_LOCK:
+        label = _PROC_LABEL
+    pid = os.getpid()
+    return {
+        "proc": label or f"pid-{pid}",
+        "pid": pid,
+        "start_time": round(_PROC_START, 3),
+    }
+
+
+def identity_key(identity: Optional[Dict[str, Any]]) -> Optional[Tuple]:
+    """Hashable dedupe key for a proc_identity dict (None when absent or
+    malformed — such sources are never merged with anything)."""
+    if not isinstance(identity, dict):
+        return None
+    pid, start = identity.get("pid"), identity.get("start_time")
+    if pid is None or start is None:
+        return None
+    return (int(pid), float(start))
+
+
+def scrape_payload(
+    reg: Optional[MetricsRegistry] = None, probe: bool = False
+) -> Dict[str, Any]:
+    """The ``GET /metrics?sketches=1`` JSON body a federation scrape
+    consumes in one exchange: the classic text exposition (parsed with
+    `parse_prometheus`, counters/gauges/quantile values), the mergeable
+    histogram sketch state (`MetricsRegistry.export_sketches`), and this
+    process's identity (the dedupe/merge key).
+
+    With ``probe=True`` (``?probe=1``) only the identity is returned.
+    A federator requests this once it has learned a target shares its
+    own process: the full exposition would be discarded by the identity
+    dedupe anyway, and rendering it on every scrape makes in-process
+    workers pay GIL time proportional to registry size just to prove
+    they are alive."""
+    if probe:
+        return {"proc_identity": proc_identity(), "probe": True}
+    reg = reg or obs_registry()
+    return {
+        "proc_identity": proc_identity(),
+        "exposition": reg.render_prometheus(),
+        "sketches": reg.export_sketches(),
+    }
+
+
+def _parse_meta(text: str) -> Tuple[Dict[str, str], Dict[str, str]]:
+    """(types, helps) from ``# TYPE`` / ``# HELP`` comment lines — the
+    family metadata `parse_prometheus` deliberately skips, which the
+    merge layer needs to pick summation vs pass-through."""
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+        elif line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) >= 4:
+                helps[parts[2]] = parts[3]
+    return types, helps
+
+
+@dataclass
+class FederationConfig:
+    """Federation knobs (docs/observability.md "Federation").
+
+    `extra_targets` adds federation-only peers — (host, port) pairs the
+    gateway scrapes and fans debug queries out to without routing API
+    traffic at them. This is the seam the real-subprocess integration
+    test uses, and the shape multi-host pools will plug into."""
+
+    enabled: bool = True
+    scrape_interval_s: float = 2.0
+    scrape_timeout_s: float = 5.0
+    #: a worker whose last successful scrape is older than
+    #: stale_after_intervals * scrape_interval_s is suspect (router view)
+    stale_after_intervals: int = 3
+    #: re-export label values
+    cluster_proc_label: str = "cluster"
+    gateway_proc_label: str = "gateway"
+    #: cluster-SLO feed: diff this summary family's _count/_sum per
+    #: (engine, code) and replay the deltas into the local SLOMonitor
+    feed_slo: bool = True
+    slo_source_family: str = "serving_request_latency_ms"
+    #: engine label the synthesized events carry; None lets the gateway
+    #: pick a per-instance label (``<gateway_label>-cluster``)
+    slo_engine: Optional[str] = None
+    #: per-series cap on events replayed per scrape round (burst guard)
+    slo_max_events_per_scrape: int = 1024
+    extra_targets: Tuple[Tuple[str, int], ...] = ()
+
+
+class _Target:
+    """Scrape-side state for one federation peer."""
+
+    __slots__ = (
+        "name", "fetch", "last_attempt_t", "last_success_t", "last_error",
+        "identity", "types", "helps", "samples", "raw", "offsets",
+        "sketches", "ok_count", "fail_count",
+    )
+
+    def __init__(self, name: str,
+                 fetch: Callable[[str], Tuple[int, bytes]],
+                 now: float):
+        self.name = name
+        self.fetch = fetch
+        self.last_attempt_t: Optional[float] = None
+        # staleness is measured from registration until the first success
+        # (grace: a just-added worker is not "stale", it is unscraped)
+        self.last_success_t = now
+        self.last_error: Optional[str] = None
+        self.identity: Optional[Dict[str, Any]] = None
+        self.types: Dict[str, str] = {}
+        self.helps: Dict[str, str] = {}
+        #: reset-corrected samples (what federation re-exports)
+        self.samples: Dict[Tuple[str, Tuple], float] = {}
+        #: last raw counter-like readings (reset detection)
+        self.raw: Dict[Tuple[str, Tuple], float] = {}
+        #: per-series monotonic carry across worker restarts
+        self.offsets: Dict[Tuple[str, Tuple], float] = {}
+        self.sketches: Dict[str, Any] = {}
+        self.ok_count = 0
+        self.fail_count = 0
+
+
+class Federator:
+    """Scrapes a set of peers, merges their metric state with the local
+    registry, and renders the federated exposition. Thread-safe; one
+    instance per gateway."""
+
+    def __init__(
+        self,
+        reg: Optional[MetricsRegistry] = None,
+        config: Optional[FederationConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        slo: Optional[Any] = None,
+        slo_engine: Optional[str] = None,
+        slo_exclude_engines: Tuple[str, ...] = (),
+        gateway_label: Optional[str] = None,
+    ):
+        self.config = config or FederationConfig()
+        self._reg = reg or obs_registry()
+        self._clock = clock
+        self._slo = slo
+        self.slo_engine = (
+            slo_engine or self.config.slo_engine or "cluster"
+        )
+        self._slo_exclude = set(slo_exclude_engines)
+        self._slo_exclude.add(self.slo_engine)
+        # the registry is process-global and gateways get torn up and down
+        # within one process (tests, hot restarts): the gateway label keys
+        # this instance's telemetry children apart, same contract as the
+        # serving_fabric_* families
+        self._gw = gateway_label or "gateway"
+        # _lock guards target/merge state; _scrape_lock serializes scrape
+        # rounds. NEITHER is ever held across a network fetch: a scraped
+        # peer may share this process's registry (in-process workers), and
+        # rendering it evaluates this federator's staleness gauge — a lock
+        # held over the fetch would deadlock against the reply it awaits
+        self._lock = threading.RLock()
+        self._scrape_lock = threading.Lock()
+        self._targets: Dict[str, _Target] = {}
+        self._slo_base: Dict[Tuple, Tuple[float, float]] = {}
+        #: source identities that already have a baseline epoch (see
+        #: _feed_slo: priming is per-SOURCE, not per-series)
+        self._slo_seen: set = set()
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._scrape_hist = self._reg.histogram(
+            "obs_federation_scrape_seconds",
+            "Federation scrape duration per worker (fetch + parse + merge)",
+            ("gateway", "worker"),
+        )
+        self._fail_counter = self._reg.counter(
+            "obs_federation_scrape_failures_total",
+            "Failed federation scrapes per worker by failure kind",
+            ("gateway", "worker", "kind"),
+        )
+        self._stale_gauge = self._reg.gauge(
+            "obs_federation_staleness_seconds",
+            "Seconds since the last successful federation scrape per worker",
+            ("gateway", "worker"),
+        )
+
+    # -- targets ---------------------------------------------------------------
+
+    def set_targets(
+        self, targets: Dict[str, Callable[[str], Tuple[int, bytes]]]
+    ) -> None:
+        """Replace the scrape-target set. Each value fetches a path from
+        that peer and returns (status, body) — transport errors raise.
+        Existing per-target state survives for names that persist."""
+        with self._lock:
+            for name in list(self._targets):
+                if name not in targets:
+                    del self._targets[name]
+                    self._stale_gauge.remove(gateway=self._gw, worker=name)
+            now = self._clock()
+            for name, fetch in targets.items():
+                tgt = self._targets.get(name)
+                if tgt is None:
+                    self._targets[name] = tgt = _Target(name, fetch, now)
+                    self._stale_gauge.labels(
+                        gateway=self._gw, worker=name
+                    ).set_function(
+                        lambda n=name: round(self.staleness_s(n), 3)
+                    )
+                else:
+                    tgt.fetch = fetch
+
+    def target_names(self) -> List[str]:
+        with self._lock:
+            return list(self._targets)
+
+    # -- scraping --------------------------------------------------------------
+
+    def _counter_like(self, name: str, types: Dict[str, str]) -> bool:
+        if types.get(name) == "counter":
+            return True
+        for suffix in ("_count", "_sum"):
+            if name.endswith(suffix):
+                base = name[: -len(suffix)]
+                if types.get(base) == "summary":
+                    return True
+        return False
+
+    def _fail(self, tgt: _Target, kind: str, err: BaseException) -> None:
+        tgt.fail_count += 1
+        tgt.last_error = repr(err)
+        self._fail_counter.labels(
+            gateway=self._gw, worker=tgt.name, kind=kind
+        ).inc()
+        log.warning(
+            "federation_scrape_failed", worker=tgt.name, kind=kind,
+            error=repr(err),
+            staleness_s=round(self.staleness_s(tgt.name), 3),
+        )
+
+    def scrape_target(self, name: str) -> bool:
+        with self._scrape_lock:
+            return self._scrape_one(name)
+
+    def _scrape_one(self, name: str) -> bool:
+        """One scrape of one peer; returns success. Failures are counted
+        by kind (transport/http/parse), logged structurally, and leave the
+        previous good state in place — a dead worker's last-known series
+        keep rendering (with its staleness gauge rising) rather than
+        vanishing mid-incident. The fetch runs OUTSIDE every lock (see
+        __init__); only the state swap afterwards takes `_lock`."""
+        me = identity_key(proc_identity())
+        with self._lock:
+            tgt = self._targets.get(name)
+            probe = (
+                tgt is not None
+                and tgt.identity is not None
+                and identity_key(tgt.identity) == me
+            )
+        if tgt is None:
+            raise KeyError(f"unknown federation target {name!r}")
+        # a target known to share this process gets an identity-only
+        # probe: its exposition would be dropped by the identity dedupe,
+        # so don't make it render the registry just to prove liveness
+        path = ("/metrics?sketches=1&probe=1" if probe
+                else "/metrics?sketches=1")
+        t0 = self._clock()
+        tgt.last_attempt_t = t0
+        try:
+            status, body = tgt.fetch(path)
+        except Exception as e:  # transport: refused, timeout, reset
+            self._fail(tgt, "transport", e)
+            return False
+        if status != 200:
+            self._fail(tgt, "http", RuntimeError(f"HTTP {status}"))
+            return False
+        try:
+            identity, text, sketches = self._decode_payload(body)
+            if (identity is not None
+                    and identity_key(identity) == me):
+                # the peer shares THIS process's registry (today's
+                # in-process workers): its parsed samples would be
+                # discarded by the identity dedupe in sources() anyway,
+                # so skip the parse/merge and keep the scrape as proof
+                # of liveness — this is most of a scrape round's cost
+                samples, types, helps, sketches = {}, {}, {}, {}
+            else:
+                samples = parse_prometheus(text)
+                types, helps = _parse_meta(text)
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
+            self._fail(tgt, "parse", e)
+            return False
+        with self._lock:
+            # counter-reset correction: a restarted worker's counters drop
+            # to zero; folding the pre-restart reading into a per-series
+            # offset keeps every re-exported counter monotonic
+            restarted = (
+                tgt.identity is not None
+                and identity is not None
+                and identity_key(identity) != identity_key(tgt.identity)
+            )
+            corrected: Dict[Tuple[str, Tuple], float] = {}
+            new_raw: Dict[Tuple[str, Tuple], float] = {}
+            for key, value in samples.items():
+                if self._counter_like(key[0], types):
+                    prev = tgt.raw.get(key)
+                    if prev is not None and (restarted or value < prev):
+                        tgt.offsets[key] = tgt.offsets.get(key, 0.0) + prev
+                    new_raw[key] = value
+                    corrected[key] = tgt.offsets.get(key, 0.0) + value
+                else:
+                    corrected[key] = value
+            tgt.identity = identity
+            tgt.types = types
+            tgt.helps = helps
+            tgt.samples = corrected
+            tgt.raw = new_raw
+            tgt.sketches = sketches
+            tgt.last_success_t = self._clock()
+            tgt.last_error = None
+            tgt.ok_count += 1
+        self._scrape_hist.labels(gateway=self._gw, worker=name).observe(
+            max(0.0, tgt.last_success_t - t0)
+        )
+        return True
+
+    @staticmethod
+    def _decode_payload(
+        body: bytes,
+    ) -> Tuple[Optional[Dict[str, Any]], str, Dict[str, Any]]:
+        """A federation payload (JSON with identity + sketches) or, as a
+        downgrade path, a bare classic exposition from a peer that does
+        not speak ``?sketches=1``."""
+        text = body.decode("utf-8")
+        if text.lstrip().startswith("{"):
+            payload = json.loads(text)
+            return (
+                payload.get("proc_identity"),
+                payload.get("exposition", ""),
+                payload.get("sketches") or {},
+            )
+        return None, text, {}
+
+    def scrape_all(self, force: bool = False) -> int:
+        """Scrape every target whose last attempt is older than the
+        configured interval (all of them with ``force=True``); then, if
+        anything was scraped, replay worker request outcomes into the SLO
+        monitor. Returns the number of targets scraped."""
+        scraped = 0
+        with self._scrape_lock:
+            now = self._clock()
+            with self._lock:
+                due = [
+                    name
+                    for name, tgt in self._targets.items()
+                    if force
+                    or tgt.last_attempt_t is None
+                    or now - tgt.last_attempt_t
+                    >= self.config.scrape_interval_s
+                ]
+            for name in due:
+                try:
+                    self._scrape_one(name)
+                except KeyError:
+                    continue  # target removed mid-round
+                scraped += 1
+            if scraped and self.config.feed_slo:
+                self._feed_slo()
+        return scraped
+
+    # -- staleness -------------------------------------------------------------
+
+    def staleness_s(self, name: str) -> float:
+        # deliberately lock-free (dict read + float read, atomic under the
+        # GIL): this is the staleness gauge's scrape-time callback, and a
+        # peer sharing this process renders that gauge while a scrape of
+        # it is in flight — taking _lock here would re-create the deadlock
+        # the fetch-outside-locks rule exists to prevent
+        tgt = self._targets.get(name)
+        if tgt is None:
+            return 0.0
+        return max(0.0, self._clock() - tgt.last_success_t)
+
+    def is_stale(self, name: str) -> bool:
+        """True when `name` has been unscrapeable past the staleness
+        budget — the router-health signal (a worker that stopped
+        answering scrapes is suspect even if its socket accepts)."""
+        limit = (
+            self.config.stale_after_intervals * self.config.scrape_interval_s
+        )
+        return self.staleness_s(name) > limit
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``/healthz`` federation block: per-worker scrape health."""
+        with self._lock:
+            return {
+                "scrape_interval_s": self.config.scrape_interval_s,
+                "stale_after_intervals": self.config.stale_after_intervals,
+                "slo_engine": self.slo_engine,
+                "targets": {
+                    name: {
+                        "staleness_s": round(self.staleness_s(name), 3),
+                        "stale": self.is_stale(name),
+                        "scrapes_ok": tgt.ok_count,
+                        "scrapes_failed": tgt.fail_count,
+                        "last_error": tgt.last_error,
+                        "proc_identity": tgt.identity,
+                    }
+                    for name, tgt in self._targets.items()
+                },
+            }
+
+    # -- merge / render --------------------------------------------------------
+
+    def _local_source(self) -> Dict[str, Any]:
+        text = self._reg.render_prometheus()
+        types, helps = _parse_meta(text)
+        return {
+            "label": self.config.gateway_proc_label,
+            "local": True,
+            "identity": proc_identity(),
+            "samples": parse_prometheus(text),
+            "types": types,
+            "helps": helps,
+            "sketches": self._reg.export_sketches(),
+        }
+
+    def sources(self) -> List[Dict[str, Any]]:
+        """Merge inputs, deduped by process identity: the local registry
+        first, then every successfully-scraped target whose identity is
+        NOT one already seen. Today's in-process workers all collapse into
+        the single local source (their scrapes ARE the shared registry);
+        real subprocess workers each contribute their own."""
+        with self._lock:
+            out = [self._local_source()]
+            seen = {identity_key(out[0]["identity"])}
+            for name, tgt in self._targets.items():
+                if not tgt.samples and tgt.identity is None:
+                    continue  # never scraped successfully
+                key = identity_key(tgt.identity)
+                if key is not None and key in seen:
+                    continue
+                if key is not None:
+                    seen.add(key)
+                out.append({
+                    "label": name,
+                    "local": False,
+                    "identity": tgt.identity,
+                    "samples": tgt.samples,
+                    "types": tgt.types,
+                    "helps": tgt.helps,
+                    "sketches": tgt.sketches,
+                })
+            return out
+
+    @staticmethod
+    def _labels_str(labels: Tuple, proc: str,
+                    extra: Optional[Tuple[str, str]] = None) -> str:
+        pairs = list(labels)
+        if not any(k == "proc" for k, _ in pairs):
+            pairs.append(("proc", proc))
+        if extra is not None:
+            pairs.append(extra)
+        pairs.sort()
+        body = ",".join(
+            f'{k}="{_escape_label(str(v))}"' for k, v in pairs
+        )
+        return "{" + body + "}" if body else ""
+
+    def _local_exemplars(self) -> Dict[Tuple[str, Tuple], str]:
+        """Exemplar suffixes for gateway-local histogram ``_count`` lines
+        (the ``?exemplars=1`` opt-in; remote scrapes don't carry them)."""
+        from mmlspark_tpu.obs.metrics import Histogram
+
+        out: Dict[Tuple[str, Tuple], str] = {}
+        if not self._reg.enabled:
+            return out
+        for fam in self._reg.families():
+            if not isinstance(fam, Histogram):
+                continue
+            for key, child in fam.children():
+                ex = child.exemplar()
+                if ex is None:
+                    continue
+                v, tid, sid, ts = ex
+                pairs = [("trace_id", tid)]
+                if sid:
+                    pairs.append(("span_id", sid))
+                blob = ",".join(
+                    f'{n}="{_escape_label(x)}"' for n, x in pairs
+                )
+                labels = tuple(sorted(zip(fam.labelnames, key)))
+                out[(fam.name, labels)] = (
+                    f" # {{{blob}}} {_format_value(v)} {round(ts, 3)}"
+                )
+        return out
+
+    def _family_meta(
+        self, srcs: List[Dict[str, Any]]
+    ) -> Dict[str, Tuple[str, str]]:
+        meta: Dict[str, Tuple[str, str]] = {}
+        summary_parts = set()
+        for src in srcs:
+            for fam, kind in src["types"].items():
+                if fam not in meta:
+                    meta[fam] = (kind, src["helps"].get(fam, ""))
+                if kind == "summary":
+                    summary_parts.add(fam + "_count")
+                    summary_parts.add(fam + "_sum")
+        # series with no TYPE line anywhere (foreign exposition): untyped
+        for src in srcs:
+            for (name, _labels) in src["samples"]:
+                if name not in meta and name not in summary_parts:
+                    meta[name] = ("untyped", "")
+        return meta
+
+    def render_text(self, exemplars: bool = False) -> str:
+        """The federated exposition: per-source series under their `proc`
+        label plus ``proc="cluster"`` aggregates (summed counters, merged
+        sketch quantiles with summed count/sum). Valid 0.0.4 text — it
+        parses back through `parse_prometheus` (the round-trip gate)."""
+        srcs = self.sources()
+        cluster = self.config.cluster_proc_label
+        local_ex = self._local_exemplars() if exemplars else {}
+        meta = self._family_meta(srcs)
+        lines: List[str] = []
+        for fam in sorted(meta):
+            kind, help_ = meta[fam]
+            if help_:
+                lines.append(f"# HELP {fam} {help_}")
+            lines.append(f"# TYPE {fam} {kind}")
+            if kind == "summary":
+                self._render_summary(
+                    lines, fam, srcs, cluster, local_ex
+                )
+            elif kind == "counter":
+                totals: Dict[Tuple, float] = {}
+                for src in srcs:
+                    for (name, labels), v in sorted(src["samples"].items()):
+                        if name != fam:
+                            continue
+                        lines.append(
+                            fam + self._labels_str(labels, src["label"])
+                            + f" {_format_value(v)}"
+                        )
+                        totals[labels] = totals.get(labels, 0.0) + v
+                for labels in sorted(totals):
+                    lines.append(
+                        fam + self._labels_str(labels, cluster)
+                        + f" {_format_value(totals[labels])}"
+                    )
+            else:  # gauge / untyped: labelled pass-through, no aggregate
+                for src in srcs:
+                    for (name, labels), v in sorted(src["samples"].items()):
+                        if name != fam:
+                            continue
+                        lines.append(
+                            fam + self._labels_str(labels, src["label"])
+                            + f" {_format_value(v)}"
+                        )
+        return "\n".join(lines) + "\n"
+
+    def _render_summary(
+        self, lines: List[str], fam: str, srcs: List[Dict[str, Any]],
+        cluster: str, local_ex: Dict[Tuple[str, Tuple], str],
+    ) -> None:
+        # per-proc pass-through: quantile values, then _count/_sum
+        cl_counts: Dict[Tuple, List[float]] = {}
+        cl_sketch: Dict[Tuple, QuantileSketch] = {}
+        cl_quant: Dict[Tuple, List[float]] = {}
+        for src in srcs:
+            label = src["label"]
+            for (name, labels), v in sorted(src["samples"].items()):
+                if name == fam:
+                    lines.append(
+                        fam + self._labels_str(labels, label)
+                        + f" {_format_value(v)}"
+                    )
+            for (name, labels), v in sorted(src["samples"].items()):
+                if name == fam + "_count":
+                    ex = local_ex.get((fam, labels), "") if src["local"] else ""
+                    lines.append(
+                        f"{fam}_count" + self._labels_str(labels, label)
+                        + f" {_format_value(v)}{ex}"
+                    )
+                    cl_counts.setdefault(labels, [0.0, 0.0])[0] += v
+                elif name == fam + "_sum":
+                    lines.append(
+                        f"{fam}_sum" + self._labels_str(labels, label)
+                        + f" {_format_value(v)}"
+                    )
+                    cl_counts.setdefault(labels, [0.0, 0.0])[1] += v
+            fam_sk = src["sketches"].get(fam)
+            if fam_sk:
+                for series in fam_sk.get("series", ()):
+                    lk = tuple(sorted(
+                        (str(k), str(v)) for k, v in series["labels"].items()
+                    ))
+                    try:
+                        sk = QuantileSketch.from_dict(series["sketch"])
+                    except (KeyError, TypeError, ValueError) as e:
+                        log.warning("federation_sketch_invalid",
+                                    family=fam, error=repr(e))
+                        continue
+                    if lk in cl_sketch:
+                        cl_sketch[lk].merge(sk)
+                    else:
+                        cl_sketch[lk] = sk
+                    cl_quant.setdefault(
+                        lk, list(fam_sk.get("quantiles") or (0.5, 0.95, 0.99))
+                    )
+        # cluster aggregate: merged-sketch quantiles (honest cluster p99),
+        # summed monotonic count/sum. After a worker restart the counts
+        # keep the reset-corrected offset while the sketch restarts with
+        # the process — standard counter-vs-distribution semantics.
+        for labels in sorted(cl_counts):
+            sk = cl_sketch.get(labels)
+            if sk is not None and sk.count > 0:
+                for q in cl_quant.get(labels, (0.5, 0.95, 0.99)):
+                    lines.append(
+                        fam + self._labels_str(
+                            labels, cluster, extra=("quantile", str(q))
+                        )
+                        + f" {_format_value(sk.quantile(q))}"
+                    )
+            cnt, sm = cl_counts[labels]
+            lines.append(
+                f"{fam}_count" + self._labels_str(labels, cluster)
+                + f" {_format_value(cnt)}"
+            )
+            lines.append(
+                f"{fam}_sum" + self._labels_str(labels, cluster)
+                + f" {_format_value(sm)}"
+            )
+
+    def merged_sketches(self) -> Dict[str, Any]:
+        """Cluster-merged sketch state in the `export_sketches` shape, so
+        a gateway can itself be scraped by a parent federator
+        (hierarchical federation) without losing mergeability."""
+        merged: Dict[str, Any] = {}
+        for src in self.sources():
+            for fam, fam_sk in src["sketches"].items():
+                slot = merged.setdefault(fam, {
+                    "help": fam_sk.get("help", ""),
+                    "labelnames": fam_sk.get("labelnames", []),
+                    "quantiles": fam_sk.get("quantiles", [0.5, 0.95, 0.99]),
+                    "_series": {},
+                })
+                for series in fam_sk.get("series", ()):
+                    lk = tuple(sorted(series["labels"].items()))
+                    try:
+                        sk = QuantileSketch.from_dict(series["sketch"])
+                    except (KeyError, TypeError, ValueError):
+                        continue
+                    cur = slot["_series"].get(lk)
+                    if cur is None:
+                        slot["_series"][lk] = {
+                            "labels": dict(series["labels"]),
+                            "sketch": sk,
+                            "sum": float(series.get("sum", 0.0)),
+                        }
+                    else:
+                        cur["sketch"].merge(sk)
+                        cur["sum"] += float(series.get("sum", 0.0))
+        out: Dict[str, Any] = {}
+        for fam, slot in merged.items():
+            out[fam] = {
+                "help": slot["help"],
+                "labelnames": slot["labelnames"],
+                "quantiles": slot["quantiles"],
+                "series": [
+                    {
+                        "labels": s["labels"],
+                        "sketch": s["sketch"].to_dict(),
+                        "sum": s["sum"],
+                    }
+                    for _lk, s in sorted(slot["_series"].items())
+                ],
+            }
+        return out
+
+    def render_scrape(self, query: str = "") -> Tuple[bytes, str]:
+        """(body, content_type) for the federated ``GET /metrics``.
+        Refreshes due targets first, so a quiet gateway still serves a
+        current cluster view. ``?sketches=1`` answers with the federation
+        JSON payload (identity + exposition + cluster-merged sketches);
+        ``?exemplars=1`` appends gateway-local exemplars."""
+        opts = urllib.parse.parse_qs(query or "")
+
+        def flag(name: str) -> bool:
+            return opts.get(name, ["0"])[-1].lower() in ("1", "true")
+
+        if flag("probe"):
+            # identity-only liveness answer for an in-process parent
+            # federator (see scrape_payload): no refresh, no render
+            body = json.dumps(
+                scrape_payload(probe=True), sort_keys=True
+            ).encode("utf-8")
+            return body, "application/json"
+        self.scrape_all()
+        exemplars = flag("exemplars")
+        text = self.render_text(exemplars=exemplars)
+        if flag("sketches"):
+            body = json.dumps({
+                "proc_identity": proc_identity(),
+                "exposition": text,
+                "sketches": self.merged_sketches(),
+            }, sort_keys=True).encode("utf-8")
+            return body, "application/json"
+        ct = (EXEMPLAR_CONTENT_TYPE if exemplars
+              else "text/plain; version=0.0.4")
+        return text.encode("utf-8"), ct
+
+    # -- cluster SLO feed ------------------------------------------------------
+
+    def _feed_slo(self) -> None:
+        """Replay worker-side request outcomes into the local SLOMonitor
+        under the cluster engine label, from the federated count/sum
+        deltas — a cluster SLOSpec burns at the gateway on failures it
+        never forwarded. First sight of a SOURCE primes its baselines
+        without replaying history (pre-federation counts have no
+        timestamps to honestly replay); a series first appearing LATER
+        from an already-baselined source accumulated entirely under
+        federation, so its whole count replays from an implicit zero —
+        an error burst mid-incident must not be swallowed as 'history'
+        just because code="500" had never been seen before."""
+        slo = self._slo
+        if slo is None:
+            from mmlspark_tpu.obs.slo import slo_monitor
+
+            slo = self._slo = slo_monitor()
+        fam = self.config.slo_source_family
+        for src in self._slo_sources():
+            ident = identity_key(src["identity"]) or ("src", src["label"])
+            first_sight = ident not in self._slo_seen
+            self._slo_seen.add(ident)
+            for (name, labels), count in sorted(src["samples"].items()):
+                if name != fam + "_count":
+                    continue
+                lab = dict(labels)
+                engine, code = lab.get("engine"), lab.get("code")
+                if engine is None or code is None:
+                    continue
+                if engine in self._slo_exclude:
+                    continue
+                total = src["samples"].get((fam + "_sum", labels), 0.0)
+                skey = (ident, engine, code)
+                base = self._slo_base.get(skey)
+                self._slo_base[skey] = (count, total)
+                if base is None:
+                    if first_sight:
+                        continue  # prime, don't replay pre-fed history
+                    base = (0.0, 0.0)  # new series under federation
+                delta = count - base[0]
+                if delta <= 0:
+                    continue
+                latency_ms = max(0.0, (total - base[1]) / delta)
+                n = int(min(delta, self.config.slo_max_events_per_scrape))
+                try:
+                    code_i = int(float(code))
+                except ValueError:
+                    continue
+                slo.observe_batch(
+                    self.slo_engine, code_i, latency_ms, n
+                )
+
+    def _slo_sources(self) -> List[Dict[str, Any]]:
+        """Identity-deduped sources for the SLO feed only. Runs every
+        background scrape round, so the local side reads count/sum
+        straight off the one family's child objects instead of the
+        render→parse detour `sources()` pays (which the feed would then
+        throw 99% of away) — the full path stays for the render
+        surfaces, which need every family."""
+        from mmlspark_tpu.obs.metrics import Histogram
+
+        fam_name = self.config.slo_source_family
+        local: Dict[Tuple[str, Tuple], float] = {}
+        if self._reg.enabled:
+            for fam in self._reg.families():
+                if fam.name != fam_name or not isinstance(fam, Histogram):
+                    continue
+                for key, child in fam.children():
+                    labels = tuple(sorted(zip(fam.labelnames, key)))
+                    local[(fam_name + "_count", labels)] = float(
+                        child.count()
+                    )
+                    local[(fam_name + "_sum", labels)] = float(child.sum())
+        out = [{
+            "label": self.config.gateway_proc_label,
+            "identity": proc_identity(),
+            "samples": local,
+        }]
+        seen = {identity_key(out[0]["identity"])}
+        with self._lock:
+            for name, tgt in self._targets.items():
+                if not tgt.samples and tgt.identity is None:
+                    continue
+                key = identity_key(tgt.identity)
+                if key is not None and key in seen:
+                    continue
+                if key is not None:
+                    seen.add(key)
+                out.append({
+                    "label": name,
+                    "identity": tgt.identity,
+                    "samples": tgt.samples,
+                })
+        return out
+
+    # -- debug fan-out ---------------------------------------------------------
+
+    def fanout_debug(
+        self, path: str, local_payload: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
+        """``?scope=cluster`` fan-out for a /debug/* endpoint: fetch every
+        target's payload (per-worker timeout; a dead worker yields an
+        explicit ``{"worker": i, "error": ...}`` entry under "errors",
+        never a hang), merged keyed by process identity — same-process
+        payloads (today's in-process workers) collapse into one entry."""
+        procs: Dict[str, Any] = {}
+        errors: List[Dict[str, Any]] = []
+        seen = set()
+        if local_payload is not None:
+            procs[self.config.gateway_proc_label] = local_payload
+            key = identity_key(local_payload.get("proc_identity"))
+            if key is not None:
+                seen.add(key)
+        with self._lock:
+            targets = list(self._targets.items())
+        for idx, (name, tgt) in enumerate(targets):
+            try:
+                status, body = tgt.fetch(path)
+                if status != 200:
+                    raise RuntimeError(f"HTTP {status}")
+                payload = json.loads(body.decode("utf-8"))
+            except Exception as e:  # partial results, never a dead scrape
+                log.warning("federation_fanout_failed", worker=name,
+                            path=path, error=repr(e))
+                errors.append({"worker": idx, "error": repr(e)})
+                continue
+            key = (
+                identity_key(payload.get("proc_identity"))
+                if isinstance(payload, dict) else None
+            )
+            if key is not None and key in seen:
+                continue
+            if key is not None:
+                seen.add(key)
+            procs[name] = payload
+        return {"scope": "cluster", "procs": procs, "errors": errors}
+
+    # -- background loop / lifecycle -------------------------------------------
+
+    def start(self) -> "Federator":
+        """Start the interval scrape thread (daemon). Tests that inject a
+        clock drive `scrape_all` directly instead."""
+        if self._thread is not None:
+            return self
+        self._stop_evt.clear()
+        t = threading.Thread(
+            target=self._loop, name="obs-federation", daemon=True
+        )
+        self._thread = t
+        t.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop_evt.wait(self.config.scrape_interval_s):
+            try:
+                self.scrape_all()
+            except Exception as e:  # the loop must survive any one round
+                log.warning("federation_loop_error", error=repr(e))
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    def close(self) -> None:
+        """Stop the loop and unhook the per-worker staleness callbacks so
+        the process registry doesn't pin a stopped gateway (same teardown
+        contract as ServingFabric.close). Cumulative scrape counters and
+        duration histograms stay, as counters should."""
+        self.stop()
+        with self._lock:
+            for name in list(self._targets):
+                self._stale_gauge.remove(gateway=self._gw, worker=name)
+            self._targets.clear()

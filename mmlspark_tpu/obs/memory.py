@@ -510,7 +510,10 @@ class DeviceMemoryLedger:
             d: round(sum(by_cls.values()) / cap, 6)
             for d, by_cls in snap.items()
         } if cap > 0 else {}
+        from mmlspark_tpu.obs.federation import proc_identity
+
         return {
+            "proc_identity": proc_identity(),
             "classes": list(CLASSES),
             "resident": snap,
             "total_bytes": self.total_bytes(),

@@ -118,6 +118,65 @@ class QuantileSketch:
     def quantiles(self, qs: Iterable[float]) -> List[float]:
         return [self.quantile(q) for q in qs]
 
+    # -- merge / serialization (the histogram-federation substrate) -----------
+    #
+    # Error bound under merge (docs/observability.md "Federation"): a merge
+    # concatenates level buffers weight-for-weight, so it introduces NO new
+    # error by itself; only the compactions it triggers do, and each
+    # compaction of level i perturbs any rank by at most 2^i — the same
+    # budget the streaming path spends. The merged sketch therefore keeps
+    # the streaming guarantee: rank error O(log(n/k)/k) over the COMBINED
+    # count n, not the sum of both inputs' worst cases. Merging m sketches
+    # is no worse than one sketch that saw all n values in sequence.
+
+    def merge(self, other: "QuantileSketch") -> None:
+        """Fold `other` into this sketch in place. Level buffers concatenate
+        weight-for-weight (level i carries weight 2^i in both), then any
+        overfull level compacts through the usual deterministic path."""
+        if other.count == 0:
+            return
+        self.count += other.count
+        if other.min < self.min:
+            self.min = other.min
+        if other.max > self.max:
+            self.max = other.max
+        for i, lvl in enumerate(other._levels):
+            while i >= len(self._levels):
+                self._levels.append([])
+                self._parity.append(0)
+            self._levels[i].extend(lvl)
+        # compact bottom-up: a spill from level i lands in i+1 before i+1
+        # is itself checked, so one pass restores the <k invariant
+        for i in range(len(self._levels)):
+            while len(self._levels[i]) >= self._k:
+                self._compact(i)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-safe state (min/max are None when empty — inf round-trips
+        through json as Infinity only under nonstandard parsers)."""
+        return {
+            "k": self._k,
+            "count": self.count,
+            "min": None if self.count == 0 else self.min,
+            "max": None if self.count == 0 else self.max,
+            "levels": [list(lvl) for lvl in self._levels],
+            "parity": list(self._parity),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "QuantileSketch":
+        sk = cls(int(d["k"]))
+        levels = [[float(v) for v in lvl] for lvl in d["levels"]]
+        parity = [int(p) for p in d["parity"]]
+        if len(levels) != len(parity) or not levels:
+            raise ValueError("sketch levels/parity mismatch")
+        sk._levels = levels
+        sk._parity = parity
+        sk.count = int(d["count"])
+        sk.min = float("inf") if d["min"] is None else float(d["min"])
+        sk.max = float("-inf") if d["max"] is None else float(d["max"])
+        return sk
+
 
 def _label_key(
     labelnames: Tuple[str, ...], labels: Dict[str, str]
@@ -562,6 +621,33 @@ class MetricsRegistry:
                     EXEMPLAR_CONTENT_TYPE)
         return (self.render_prometheus().encode("utf-8"),
                 "text/plain; version=0.0.4")
+
+    def export_sketches(self) -> Dict[str, Any]:
+        """JSON-able histogram state for federation: the text exposition
+        carries quantile VALUES, which cannot be recombined into an honest
+        cluster p99 — so the federation scrape (`GET /metrics?sketches=1`)
+        ships the full mergeable sketch per series instead. Keyed by family
+        name; each series carries its labels, sketch state, and running sum
+        (count lives inside the sketch)."""
+        out: Dict[str, Any] = {}
+        for fam in self.families():
+            if not isinstance(fam, Histogram):
+                continue
+            series = []
+            for key, child in fam.children():
+                with child._lock:
+                    series.append({
+                        "labels": dict(zip(fam.labelnames, key)),
+                        "sketch": child._sketch.to_dict(),
+                        "sum": child._sum,
+                    })
+            out[fam.name] = {
+                "help": fam.help,
+                "labelnames": list(fam.labelnames),
+                "quantiles": list(fam.quantiles),
+                "series": series,
+            }
+        return out
 
 
 #: content type for the opt-in exemplar-bearing exposition: classic text

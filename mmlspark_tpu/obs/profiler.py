@@ -404,7 +404,10 @@ class DeviceProfiler:
             total = self._total_records
         if limit is not None:
             records = records[-int(limit):]
+        from mmlspark_tpu.obs.federation import proc_identity
+
         return {
+            "proc_identity": proc_identity(),
             "records": records,
             "total_records": total,
             "ring_capacity": self._records.maxlen,

@@ -222,6 +222,29 @@ class SLOMonitor:
         if due:
             self.evaluate(now)
 
+    def observe_batch(self, engine: str, code: int, latency_ms: float,
+                      n: int) -> None:
+        """Record `n` identical finished requests at once — the federation
+        feed path (obs/federation.py), which sees worker outcomes as
+        count/sum DELTAS per scrape rather than per-request calls. Events
+        land at the current clock reading (the scrape time): federated
+        burn-rate windows are therefore quantized to the scrape interval,
+        which is the documented staleness floor of any scrape-based SLO."""
+        if n <= 0 or not registry().enabled:
+            return
+        with self._lock:
+            now = self._clock()
+            ev = _Event(now, engine, int(code), float(latency_ms), None)
+            self._events.extend([ev] * int(n))
+            due = (
+                self._specs
+                and now - self._last_eval >= self.eval_interval_s
+            )
+            if due:
+                self._last_eval = now
+        if due:
+            self.evaluate(now)
+
     # -- evaluation ------------------------------------------------------------
 
     @staticmethod

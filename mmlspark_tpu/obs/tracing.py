@@ -56,7 +56,7 @@ import time
 import uuid
 from collections import deque
 from dataclasses import dataclass
-from typing import Any, Dict, Iterator, List, Mapping, Optional
+from typing import Any, Dict, Iterable, Iterator, List, Mapping, Optional
 
 __all__ = [
     "Span",
@@ -67,6 +67,7 @@ __all__ = [
     "extract_context",
     "format_traceparent",
     "inject_context",
+    "stitch_trace_trees",
 ]
 
 # wall-clock anchor for export: spans time with monotonic, export maps to
@@ -638,6 +639,61 @@ class Tracer:
         with open(path, "w", encoding="utf-8") as f:
             json.dump(trace, f)
         return len(trace["traceEvents"])
+
+
+def stitch_trace_trees(
+    trace_id: str, trees: Iterable[Dict[str, Any]]
+) -> Dict[str, Any]:
+    """Merge several processes' ``trace_tree`` payloads into ONE tree.
+
+    The federation fan-out (``GET /debug/trace?trace_id=&scope=cluster``)
+    collects one assembled tree per process; each carries the span dicts
+    that process retained. Because traceparent propagation gives every
+    cross-process child its remote parent's span_id, flattening all trees,
+    deduping by span_id (a gateway and an in-process worker can both
+    retain the same span), and re-nesting by parent_id reconstructs the
+    cluster-wide tree — a worker's http span that was a ROOT in the
+    worker's local view re-parents under the gateway's attempt span here.
+    Spans whose parent is missing everywhere stay roots, same contract as
+    ``Tracer.trace_tree``."""
+    flat: Dict[str, Dict[str, Any]] = {}
+
+    def _walk(node: Dict[str, Any]) -> None:
+        sid = node.get("span_id")
+        if sid is not None and sid not in flat:
+            flat[sid] = {k: v for k, v in node.items() if k != "children"}
+        for child in node.get("children", ()):
+            _walk(child)
+
+    flag = None
+    for tree in trees:
+        if not isinstance(tree, dict):
+            continue
+        if flag is None:
+            flag = tree.get("flag")
+        for root in tree.get("roots", ()):
+            _walk(root)
+
+    ordered = sorted(flat.values(), key=lambda d: d.get("start_ts") or 0.0)
+    by_id: Dict[str, Dict[str, Any]] = {}
+    for d in ordered:
+        d = dict(d)
+        d["children"] = []
+        by_id[d["span_id"]] = d
+    roots: List[Dict[str, Any]] = []
+    for d in ordered:
+        node = by_id[d["span_id"]]
+        parent = d.get("parent_id")
+        if parent and parent in by_id:
+            by_id[parent]["children"].append(node)
+        else:
+            roots.append(node)
+    return {
+        "trace_id": trace_id,
+        "span_count": len(by_id),
+        "flag": flag,
+        "roots": roots,
+    }
 
 
 _DROPPED = []

@@ -44,14 +44,21 @@ import json
 import socket
 import threading
 import time
+import urllib.parse
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.obs.logging import get_logger
 from mmlspark_tpu.obs import registry as obs_registry
 from mmlspark_tpu.obs import tracer as obs_tracer
+from mmlspark_tpu.obs.federation import FederationConfig, Federator
 from mmlspark_tpu.obs.slo import slo_monitor
-from mmlspark_tpu.obs.tracing import Span, extract_context, inject_context
+from mmlspark_tpu.obs.tracing import (
+    Span,
+    extract_context,
+    inject_context,
+    stitch_trace_trees,
+)
 from mmlspark_tpu.serving.fabric import (
     CircuitBreaker,
     FabricConfig,
@@ -102,6 +109,7 @@ class DistributedServingServer:
         worker_timeout: Optional[float] = None,
         fault_injector: Optional[FaultInjector] = None,
         slow_request_ms: Optional[float] = None,
+        federation: Optional[FederationConfig] = None,
     ):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
@@ -161,6 +169,27 @@ class DistributedServingServer:
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
         self._stopping = threading.Event()
         self._replace_lock = threading.Lock()
+        # cross-process observability federation (obs/federation.py): the
+        # gateway scrapes each worker's /metrics, re-exports the union
+        # under proc labels, fans /debug/* out with ?scope=cluster, and
+        # feeds worker request outcomes to the SLO monitor under the
+        # cluster engine label — `cluster_engine` is what an SLOSpec
+        # targets to burn on CLUSTER-wide outcomes, not just this edge
+        self.federation_config = federation or FederationConfig()
+        self.federator: Optional[Federator] = None
+        self.cluster_engine: Optional[str] = None
+        if self.federation_config.enabled:
+            self.cluster_engine = (
+                self.federation_config.slo_engine
+                or f"{self.fabric.gateway_label}-cluster"
+            )
+            self.federator = Federator(
+                obs_registry(),
+                self.federation_config,
+                slo_engine=self.cluster_engine,
+                slo_exclude_engines=(self.fabric.gateway_label,),
+                gateway_label=self.fabric.gateway_label,
+            )
 
     def _make_worker(
         self, factory: Optional[Callable] = None
@@ -169,9 +198,25 @@ class DistributedServingServer:
             (factory or self.handler_factory)(), **self._worker_kwargs
         )
 
-    @staticmethod
-    def _health_fn(worker: ServingServer) -> Callable[[], bool]:
-        return lambda: worker.health()[0]
+    def _health_fn(self, worker: ServingServer) -> Callable[[], bool]:
+        """Router health for one worker: its own health() signal AND
+        federation-scrape freshness — a worker whose metrics have been
+        unscrapeable for `stale_after_intervals` scrape intervals is
+        suspect even if its socket still accepts connections. Resolved
+        lazily so hot-swapped replacements and late federator wiring both
+        see current state."""
+        def check() -> bool:
+            if not worker.health()[0]:
+                return False
+            fed = self.federator
+            if fed is None or self._httpd is None:
+                return True
+            try:
+                idx = self.workers.index(worker)
+            except ValueError:  # replaced mid-check: not routable anyway
+                return True
+            return not fed.is_stale(f"worker-{idx}")
+        return check
 
     @property
     def port(self) -> int:
@@ -214,6 +259,61 @@ class DistributedServingServer:
             entry = conns.pop(idx, None)
             if entry is not None:
                 entry[1].close()
+
+    # -- federation transport --------------------------------------------------
+
+    def _fed_fetch(self, idx: int) -> Callable[[str], Tuple[int, bytes]]:
+        """Federation fetcher for worker slot `idx`, over the same cached
+        keep-alive transport as API forwards (the scrape loop runs on its
+        own thread, so it owns its own thread-local connections). The
+        scrape timeout replaces the forward timeout for the exchange and
+        is restored after — handler threads share connections between
+        ``?scope=cluster`` fan-outs and API forwards. Injected worker
+        faults are honored read-only: a killed/wedged slot fails the
+        scrape with the same exception a dead/hung peer produces, WITHOUT
+        consuming one-shot transport faults armed for API traffic."""
+        def fetch(path: str) -> Tuple[int, bytes]:
+            if self._faults is not None:
+                mode = self._faults.mode(idx)
+                if mode in ("dead", "drop"):
+                    raise ConnectionRefusedError(
+                        f"worker {idx} transport poisoned ({mode})"
+                    )
+                if mode == "wedged":
+                    raise socket.timeout(f"worker {idx} wedged")
+            timeout = self.federation_config.scrape_timeout_s
+            conn = self._worker_conn(idx)
+            try:
+                conn.sock.settimeout(timeout)
+                conn.request("GET", path, headers=inject_context(None, {}))
+                resp = conn.getresponse()
+                body = resp.read()
+                conn.sock.settimeout(self.worker_timeout)
+            except (http.client.HTTPException, ConnectionError, OSError):
+                self._drop_conn(idx)
+                raise
+            return resp.status, body
+        return fetch
+
+    def _extra_fetch(
+        self, host: str, port: int
+    ) -> Callable[[str], Tuple[int, bytes]]:
+        """Fetcher for a federation-only extra target (FederationConfig.
+        extra_targets): a peer the gateway observes but never routes API
+        traffic to, e.g. a worker in another process. One short-lived
+        connection per fetch — these are off the routing hot path and a
+        cached socket to a foreign process would outlive its restarts."""
+        def fetch(path: str) -> Tuple[int, bytes]:
+            conn = http.client.HTTPConnection(
+                host, port, timeout=self.federation_config.scrape_timeout_s
+            )
+            try:
+                conn.request("GET", path, headers=inject_context(None, {}))
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            finally:
+                conn.close()
+        return fetch
 
     def _attempt(self, idx: int, method: str, path: str, body: bytes,
                  content_type: Optional[str],
@@ -501,6 +601,27 @@ class DistributedServingServer:
     def start(self) -> "DistributedServingServer":
         for w in self.workers:
             w.start()
+        if self.federator is not None:
+            targets: Dict[str, Callable[[str], Tuple[int, bytes]]] = {
+                f"worker-{i}": self._fed_fetch(i)
+                for i in range(len(self.workers))
+            }
+            for j, (ehost, eport) in enumerate(
+                self.federation_config.extra_targets
+            ):
+                targets[f"extra-{j}"] = self._extra_fetch(ehost, int(eport))
+            self.federator.set_targets(targets)
+            fed = self.federator
+
+            def _annotate(idx: int) -> Dict[str, Any]:
+                name = f"worker-{idx}"
+                return {
+                    "scrape_staleness_s": round(fed.staleness_s(name), 3),
+                    "scrape_stale": fed.is_stale(name),
+                }
+
+            self.fabric.set_worker_annotator(_annotate)
+            self.federator.start()
         if self.fabric.config.hedge:
             from concurrent.futures import ThreadPoolExecutor
 
@@ -546,14 +667,17 @@ class DistributedServingServer:
                 # the 404 and error reply paths, which used to skip it)
                 n = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(n) if n else b""
-                # observability surfaces: workers share this process, so
-                # the gateway serves the shared registry directly and
-                # aggregates per-worker liveness (docs/observability.md)
+                # observability surfaces: the gateway serves the FEDERATED
+                # view — the union of its local registry and every scraped
+                # worker, per-proc series plus cluster aggregates
+                # (docs/observability.md "Federation")
                 if route == "/metrics":
                     parts = self.path.split("?", 1)
-                    body, ctype = obs_registry().render_scrape(
-                        parts[1] if len(parts) > 1 else ""
-                    )
+                    query = parts[1] if len(parts) > 1 else ""
+                    if outer.federator is not None:
+                        body, ctype = outer.federator.render_scrape(query)
+                    else:
+                        body, ctype = obs_registry().render_scrape(query)
                     self._send_body(200, "OK", body, ctype)
                     return
                 if route == "/healthz":
@@ -563,37 +687,61 @@ class DistributedServingServer:
                         payload, "application/json",
                     )
                     return
-                # flight-recorder surfaces: workers share this process, so
-                # the gateway serves the shared profiler ring and tracer
-                # directly, like it does /metrics (docs/observability.md)
+                # flight-recorder surfaces: local payload by default;
+                # ?scope=cluster fans out to every federation target with
+                # per-worker timeout + partial-result semantics (a dead
+                # worker is an explicit errors[] entry, never a hang) and
+                # merges keyed by process identity (docs/observability.md)
                 if route == "/debug/flight":
                     from mmlspark_tpu.obs.profiler import device_profiler
 
+                    payload: Any = device_profiler().flight()
+                    if outer._cluster_scope(self.path):
+                        payload = outer.federator.fanout_debug(
+                            outer._strip_scope(self.path), payload
+                        )
                     self._send_body(
                         200, "OK",
-                        json.dumps(device_profiler().flight(),
-                                   sort_keys=True).encode("utf-8"),
+                        json.dumps(payload, sort_keys=True).encode("utf-8"),
                         "application/json",
                     )
                     return
                 if route == "/debug/memory":
-                    # the device-memory ledger is process-wide, so the
-                    # gateway serves the same snapshot its workers would
+                    payload = _memory_payload(self.path)
+                    if outer._cluster_scope(self.path):
+                        payload = outer.federator.fanout_debug(
+                            outer._strip_scope(self.path), payload
+                        )
                     self._send_body(
                         200, "OK",
-                        json.dumps(_memory_payload(self.path),
-                                   sort_keys=True).encode("utf-8"),
+                        json.dumps(payload, sort_keys=True).encode("utf-8"),
                         "application/json",
                     )
                     return
                 if route == "/debug/trace":
                     # ?trace_id= serves the assembled cross-hop tree
                     # (gateway root -> attempts -> worker stages); no
-                    # query keeps the whole-ring Chrome-trace dump
+                    # query keeps the whole-ring Chrome-trace dump. With
+                    # scope=cluster a trace_id lookup fans out and returns
+                    # ONE stitched tree spanning every process that held
+                    # spans of the trace (traceparent supplied the links)
+                    payload = _trace_payload(self.path)
+                    if outer._cluster_scope(self.path):
+                        fwd = outer._strip_scope(self.path)
+                        agg = outer.federator.fanout_debug(fwd, payload)
+                        tid = payload.get("trace_id")
+                        if tid:
+                            stitched = stitch_trace_trees(
+                                tid, list(agg["procs"].values())
+                            )
+                            stitched["scope"] = "cluster"
+                            stitched["errors"] = agg["errors"]
+                            payload = stitched
+                        else:
+                            payload = agg
                     self._send_body(
                         200, "OK",
-                        json.dumps(_trace_payload(self.path)
-                                   ).encode("utf-8"),
+                        json.dumps(payload).encode("utf-8"),
                         "application/json",
                     )
                     return
@@ -680,6 +828,31 @@ class DistributedServingServer:
         )
         return self
 
+    def _cluster_scope(self, path: str) -> bool:
+        """True when the request asked for ``?scope=cluster`` and this
+        gateway has a federator to answer it (without one, the local
+        payload is the whole truth and the flag is ignored)."""
+        if self.federator is None:
+            return False
+        query = path.split("?", 1)[1] if "?" in path else ""
+        opts = urllib.parse.parse_qs(query)
+        return opts.get("scope", [""])[-1] == "cluster"
+
+    @staticmethod
+    def _strip_scope(path: str) -> str:
+        """The fan-out path: same endpoint + query minus ``scope`` — a
+        worker answering its own payload must not recurse the fan-out."""
+        base, _, query = path.partition("?")
+        kept = [
+            (k, v)
+            for k, vs in urllib.parse.parse_qs(query).items()
+            for v in vs
+            if k != "scope"
+        ]
+        if not kept:
+            return base
+        return base + "?" + urllib.parse.urlencode(kept)
+
     @staticmethod
     def _trace_header(span: Span) -> Tuple[Tuple[str, str], ...]:
         """An ``X-Trace-Id`` response header while the request is traced,
@@ -750,6 +923,18 @@ class DistributedServingServer:
         gw_label = self.fabric.gateway_label
         slos = slo_monitor().status(engine=gw_label)
         slo_degraded = slo_monitor().page_burn_active(engine=gw_label)
+        federation = None
+        cluster_slos = None
+        if self.federator is not None:
+            federation = self.federator.snapshot()
+            # cluster SLOs evaluate the FEDERATED request stream (the
+            # deltas every scrape replays under the cluster engine), so a
+            # worker-side burn pages here even if the gateway's own edge
+            # never saw the errors
+            cluster_slos = slo_monitor().status(engine=self.cluster_engine)
+            slo_degraded = slo_degraded or slo_monitor().page_burn_active(
+                engine=self.cluster_engine
+            )
         if stopping:
             status, code = "stopping", 503
         elif not routable:
@@ -767,6 +952,8 @@ class DistributedServingServer:
             "workers": [h[1] for h in healths],
             "router": router,
             "slos": slos,
+            "cluster_slos": cluster_slos,
+            "federation": federation,
         }, sort_keys=True).encode("utf-8")
         return code, body
 
@@ -781,6 +968,10 @@ class DistributedServingServer:
             and time.monotonic() < deadline
         ):
             time.sleep(0.005)
+        if self.federator is not None:
+            # before the workers stop: a scrape racing a dying worker is
+            # just noise in the failure counter
+            self.federator.close()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -625,6 +625,28 @@ class ServingFabric:
 
     # -- observability ---------------------------------------------------------
 
+    def set_worker_annotator(
+        self, fn: Optional[Callable[[int], Dict[str, Any]]]
+    ) -> None:
+        """Install a per-worker snapshot annotator: `fn(idx)` returns extra
+        fields merged into that worker's `snapshot()` entry. The
+        distributed gateway uses this to surface federation-scrape
+        staleness in the router block — `healthy` already folds staleness
+        in through the health_fn, and the annotation says WHY a worker
+        with a live socket went unroutable."""
+        self._annotator = fn
+
+    def _annotate(self, idx: int) -> Dict[str, Any]:
+        fn = getattr(self, "_annotator", None)
+        if fn is None:
+            return {}
+        try:
+            extra = fn(idx)
+        except Exception as e:  # a broken annotator must not break healthz
+            log.debug("snapshot_annotator_failed", worker=idx, error=repr(e))
+            return {}
+        return dict(extra) if extra else {}
+
     def snapshot(self) -> Dict[str, Any]:
         """The router block `GET /healthz` serves (docs/observability.md)."""
         with self._lock:
@@ -643,6 +665,7 @@ class ServingFabric:
                         round(w.ewma_ms, 3) if w.ewma_ms is not None else None
                     ),
                     "failures_total": w.failures_total,
+                    **self._annotate(w.idx),
                 }
                 for w in self._workers
             ]

@@ -616,9 +616,26 @@ class ServingServer:
                 if route == "/metrics":
                     self._drain_body()
                     parts = self.path.split("?", 1)
-                    body, ctype = obs_registry().render_scrape(
-                        parts[1] if len(parts) > 1 else ""
-                    )
+                    query = parts[1] if len(parts) > 1 else ""
+                    if "sketches=1" in query:
+                        # federation scrape: identity + exposition +
+                        # mergeable histogram state in one exchange
+                        # (obs/federation.py scrape_payload)
+                        from mmlspark_tpu.obs.federation import (
+                            scrape_payload,
+                        )
+
+                        body = json.dumps(
+                            scrape_payload(
+                                obs_registry(),
+                                probe="probe=1" in query,
+                            ),
+                            sort_keys=True,
+                        ).encode("utf-8")
+                        self._send(HTTPResponseData.ok(
+                            body, "application/json"))
+                        return
+                    body, ctype = obs_registry().render_scrape(query)
                     self._send(HTTPResponseData.ok(body, ctype))
                     return
                 if route == "/healthz":
@@ -1364,12 +1381,16 @@ def _trace_payload(path: str) -> Dict[str, Any]:
     ServingServer and the distributed gateway (same process tracer)."""
     import urllib.parse
 
+    from mmlspark_tpu.obs.federation import proc_identity
+
     query = path.split("?", 1)[1] if "?" in path else ""
     opts = urllib.parse.parse_qs(query)
     tid = opts.get("trace_id", [""])[-1]
-    if tid:
-        return obs_tracer().trace_tree(tid)
-    return obs_tracer().chrome_trace()
+    payload = (
+        obs_tracer().trace_tree(tid) if tid else obs_tracer().chrome_trace()
+    )
+    payload["proc_identity"] = proc_identity()
+    return payload
 
 
 def _memory_payload(path: str) -> Dict[str, Any]:

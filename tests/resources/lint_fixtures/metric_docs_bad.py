@@ -1,0 +1,22 @@
+"""Fixture for the undocumented-metric-family rule: registrations checked
+against the sibling docs/observability.md metric tables (the fixture tree
+carries its own doc so the test is hermetic). Parsed, never imported."""
+
+from mmlspark_tpu.obs import registry
+
+
+def register_instruments():
+    reg = registry()
+    # clean: documented as a plain table entry
+    reg.counter("fixture_documented_total", "d", ("engine",))
+    # clean: documented with a trailing {label} group in the table
+    reg.gauge("fixture_labeled_depth", "d", ("engine",))
+    # clean: documented through brace alternation (fixture_{in,out}_bytes_total)
+    reg.counter("fixture_in_bytes_total", "d")
+    reg.counter("fixture_out_bytes_total", "d")
+    # a prose mention outside a table row does NOT document a family
+    reg.counter("fixture_prose_only_total", "d")  # expect[undocumented-metric-family]
+    reg.gauge("fixture_ghost_gauge", "d")  # expect[undocumented-metric-family]
+    reg.histogram("fixture_ghost_ms", "d", ("engine",))  # expect[undocumented-metric-family]
+    # justified internal family: suppressed on the registration line
+    reg.counter("fixture_internal_total", "d")  # graftcheck: ignore[undocumented-metric-family]  # expect-suppressed[undocumented-metric-family]

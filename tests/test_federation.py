@@ -1,0 +1,803 @@
+"""Tests: cross-process observability federation (ISSUE 20) — mergeable
+quantile sketches, the Federator's scrape/merge/re-export semantics
+(counter reset-correction across worker restarts, label collisions,
+partial scrapes with a dead worker, parse→merge→render→parse round
+trips, the cluster SLO feed), the gateway wiring (federated /metrics,
+?scope=cluster debug fan-out, stitched traces, /healthz federation
+block), and a REAL `multiprocessing` subprocess worker federated via
+`FederationConfig.extra_targets`."""
+
+import http.client
+import json
+import multiprocessing
+import os
+import re
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame, DataType
+from mmlspark_tpu.obs.federation import (
+    FederationConfig,
+    Federator,
+    identity_key,
+    proc_identity,
+    scrape_payload,
+)
+from mmlspark_tpu.obs.metrics import (
+    MetricsRegistry,
+    QuantileSketch,
+    parse_prometheus,
+)
+from mmlspark_tpu.serving import (
+    DistributedServingServer,
+    FabricConfig,
+    ServingServer,
+    make_reply,
+    parse_request,
+)
+
+# -- helpers ------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _ident(label, pid, start=1000.0):
+    return {"proc": label, "pid": pid, "start_time": start}
+
+
+def _json_target(state):
+    """Fetch callable serving `state` as a federation JSON payload; tests
+    mutate the dict between scrapes to simulate progress and restarts."""
+
+    def fetch(path):
+        if state.get("dead"):
+            raise ConnectionRefusedError("worker gone")
+        payload = {
+            "proc_identity": state["identity"],
+            "exposition": state["exposition"],
+            "sketches": state.get("sketches", {}),
+        }
+        return 200, json.dumps(payload).encode("utf-8")
+
+    return fetch
+
+
+def _counter_expo(name, value, labels='code="200"'):
+    return (
+        f"# TYPE {name} counter\n"
+        f"{name}{{{labels}}} {value}\n"
+    )
+
+
+def _mk_fed(interval=1.0, clock=None, **kw):
+    reg = MetricsRegistry()
+    cfg = FederationConfig(scrape_interval_s=interval)
+    fed = Federator(
+        reg=reg, config=cfg, clock=clock or FakeClock(),
+        gateway_label="fed-test", **kw
+    )
+    return reg, fed
+
+
+def _echo_factory():
+    def factory():
+        def handler(df: DataFrame) -> DataFrame:
+            parsed = parse_request(df, {"x": None})
+            vals = np.asarray([float(v) * 2.0 for v in parsed["x"]])
+            return make_reply(
+                parsed.with_column("y", vals, DataType.DOUBLE), "y"
+            )
+
+        return handler
+
+    return factory
+
+
+def _post(port, api, payload, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request(
+        "POST", f"/{api}", body=json.dumps(payload),
+        headers={"Content-Type": "application/json"},
+    )
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+def _get(port, route, timeout=10):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("GET", route)
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+# -- QuantileSketch merge / serde ---------------------------------------------
+
+
+class TestSketchMerge:
+    def test_merge_matches_single_sketch_error_bound(self):
+        # 5000 values split across two sketches: merged quantiles must
+        # track true ranks about as well as one sketch over the union —
+        # merge adds no error beyond the compactions it triggers
+        rng = np.random.default_rng(7)
+        vals = rng.normal(size=5000)
+        a, b = QuantileSketch(k=128), QuantileSketch(k=128)
+        for v in vals[:2500]:
+            a.add(float(v))
+        for v in vals[2500:]:
+            b.add(float(v))
+        a.merge(b)
+        assert a.count == 5000
+        srt = np.sort(vals)
+        for q in (0.1, 0.5, 0.9, 0.99):
+            est = a.quantile(q)
+            rank = np.searchsorted(srt, est) / len(srt)
+            assert abs(rank - q) < 0.05, f"q={q}: rank {rank}"
+
+    def test_merge_is_count_and_range_exact(self):
+        a, b = QuantileSketch(k=32), QuantileSketch(k=32)
+        for v in range(100):
+            a.add(float(v))
+        for v in range(100, 300):
+            b.add(float(v))
+        a.merge(b)
+        assert a.count == 300
+        assert a.quantile(0.0) == 0.0
+        assert a.quantile(1.0) == 299.0
+
+    def test_merge_empty_is_identity(self):
+        a, b = QuantileSketch(k=32), QuantileSketch(k=32)
+        for v in range(50):
+            a.add(float(v))
+        before = a.quantiles((0.5, 0.9))
+        a.merge(b)
+        assert a.count == 50 and a.quantiles((0.5, 0.9)) == before
+        b.merge(a)
+        assert b.count == 50
+
+    def test_serde_round_trip(self):
+        a = QuantileSketch(k=64)
+        for v in range(1000):
+            a.add(float(v))
+        d = json.loads(json.dumps(a.to_dict()))  # through real JSON
+        back = QuantileSketch.from_dict(d)
+        assert back.count == a.count
+        for q in (0.01, 0.5, 0.99):
+            assert back.quantile(q) == a.quantile(q)
+        assert back.to_dict() == a.to_dict()
+
+
+# -- process identity ---------------------------------------------------------
+
+
+class TestProcIdentity:
+    def test_identity_shape_and_key(self):
+        ident = proc_identity()
+        assert ident["pid"] == os.getpid()
+        assert ident["proc"]
+        assert identity_key(ident) == (os.getpid(), ident["start_time"])
+        assert identity_key(None) is None
+        assert identity_key({"pid": 1}) is None
+
+    def test_scrape_payload_carries_identity_and_sketches(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("fedid_ms", "h", ("k",))
+        h.labels(k="a").observe(2.0)
+        payload = scrape_payload(reg)
+        assert payload["proc_identity"]["pid"] == os.getpid()
+        assert "fedid_ms" in payload["sketches"]
+        assert ("fedid_ms_count", (("k", "a"),)) in parse_prometheus(
+            payload["exposition"]
+        )
+
+    def test_probe_payload_is_identity_only(self):
+        reg = MetricsRegistry()
+        reg.histogram("fedid_probe_ms", "h", ("k",)).labels(
+            k="a"
+        ).observe(1.0)
+        payload = scrape_payload(reg, probe=True)
+        assert payload["proc_identity"]["pid"] == os.getpid()
+        assert payload["probe"] is True
+        assert "exposition" not in payload
+        assert "sketches" not in payload
+
+    def test_same_process_target_downgrades_to_probe(self):
+        # once a target is known to share this process, subsequent
+        # scrapes ask for the identity-only probe (the full exposition
+        # would be dropped by the identity dedupe anyway) and the target
+        # still counts as live
+        reg, fed = _mk_fed()
+        paths = []
+
+        def fetch(path):
+            paths.append(path)
+            probe = "probe=1" in path
+            return 200, json.dumps(
+                scrape_payload(reg, probe=probe)
+            ).encode("utf-8")
+
+        fed.set_targets({"self-peer": fetch})
+        assert fed.scrape_target("self-peer")
+        assert fed.scrape_target("self-peer")
+        assert paths == [
+            "/metrics?sketches=1",
+            "/metrics?sketches=1&probe=1",
+        ]
+        snap = fed.snapshot()["targets"]["self-peer"]
+        assert snap["scrapes_ok"] == 2
+        assert not fed.is_stale("self-peer")
+
+    def test_flight_and_memory_payloads_are_stamped(self):
+        from mmlspark_tpu.obs.memory import memory_ledger
+        from mmlspark_tpu.obs.profiler import device_profiler
+
+        for payload in (
+            device_profiler().flight(),
+            memory_ledger().debug_payload(),
+        ):
+            ident = payload["proc_identity"]
+            assert ident["pid"] == os.getpid()
+            assert identity_key(ident) is not None
+
+
+# -- Federator merge semantics ------------------------------------------------
+
+
+class TestFederatorMerge:
+    def test_counters_sum_into_cluster_series(self):
+        reg, fed = _mk_fed()
+        w1 = {"identity": _ident("w1", 111),
+              "exposition": _counter_expo("fedm_requests_total", 3)}
+        w2 = {"identity": _ident("w2", 222),
+              "exposition": _counter_expo("fedm_requests_total", 4)}
+        gw = reg.counter("fedm_requests_total", "t", ("code",))
+        gw.labels(code="200").inc(5)
+        fed.set_targets({"w1": _json_target(w1), "w2": _json_target(w2)})
+        assert fed.scrape_all(force=True) == 2
+        s = parse_prometheus(fed.render_text())
+        key = lambda proc: (
+            "fedm_requests_total",
+            (("code", "200"), ("proc", proc)),
+        )
+        assert s[key("gateway")] == 5.0
+        assert s[key("w1")] == 3.0
+        assert s[key("w2")] == 4.0
+        assert s[key("cluster")] == 12.0
+
+    def test_counter_monotonic_across_worker_restart(self):
+        _reg, fed = _mk_fed()
+        w = {"identity": _ident("w", 111, start=1000.0),
+             "exposition": _counter_expo("fedr_total", 10)}
+        fed.set_targets({"w": _json_target(w)})
+        fed.scrape_all(force=True)
+        # restart: new incarnation (same label, new pid/start), counter
+        # back near zero — the re-export must NOT go backwards
+        w["identity"] = _ident("w", 112, start=2000.0)
+        w["exposition"] = _counter_expo("fedr_total", 2)
+        fed.scrape_all(force=True)
+        s = parse_prometheus(fed.render_text())
+        k = ("fedr_total", (("code", "200"), ("proc", "cluster")))
+        assert s[k] == 12.0
+        # and keeps counting from there
+        w["exposition"] = _counter_expo("fedr_total", 5)
+        fed.scrape_all(force=True)
+        s = parse_prometheus(fed.render_text())
+        assert s[k] == 15.0
+
+    def test_counter_value_drop_without_identity_change_is_reset(self):
+        _reg, fed = _mk_fed()
+        w = {"identity": _ident("w", 111),
+             "exposition": _counter_expo("fedd_total", 9)}
+        fed.set_targets({"w": _json_target(w)})
+        fed.scrape_all(force=True)
+        w["exposition"] = _counter_expo("fedd_total", 1)
+        fed.scrape_all(force=True)
+        s = parse_prometheus(fed.render_text())
+        assert s[("fedd_total", (("code", "200"), ("proc", "cluster")))] == 10.0
+
+    def test_existing_proc_label_is_not_clobbered(self):
+        # label-collision edge case: a worker series already carrying a
+        # `proc` label passes through untouched (no double label, no
+        # overwrite), and gauges never get a cluster aggregate
+        _reg, fed = _mk_fed()
+        w = {"identity": _ident("w", 111), "exposition": (
+            "# TYPE fedc_gauge gauge\n"
+            'fedc_gauge{proc="imposter"} 7\n'
+        )}
+        fed.set_targets({"w": _json_target(w)})
+        fed.scrape_all(force=True)
+        text = fed.render_text()
+        s = parse_prometheus(text)
+        assert s[("fedc_gauge", (("proc", "imposter"),))] == 7.0
+        assert ("fedc_gauge", (("proc", "cluster"),)) not in s
+        assert text.count('proc="imposter"') == 1
+
+    def test_same_family_same_labels_across_procs_stay_distinct(self):
+        _reg, fed = _mk_fed()
+        w1 = {"identity": _ident("w1", 111),
+              "exposition": _counter_expo("fedx_total", 1)}
+        w2 = {"identity": _ident("w2", 222),
+              "exposition": _counter_expo("fedx_total", 2)}
+        fed.set_targets({"w1": _json_target(w1), "w2": _json_target(w2)})
+        fed.scrape_all(force=True)
+        text = fed.render_text()
+        # identical (family, labels) from two procs must not collide: the
+        # proc label keeps every line a distinct series after re-parse
+        assert len(parse_prometheus(text)) == len(
+            [l for l in text.splitlines() if l and not l.startswith("#")]
+        )
+
+    def test_identity_dedupe_collapses_same_process_sources(self):
+        _reg, fed = _mk_fed()
+        shared = _ident("w", 111)
+        w1 = {"identity": shared,
+              "exposition": _counter_expo("fedu_total", 6)}
+        w2 = {"identity": shared,
+              "exposition": _counter_expo("fedu_total", 6)}
+        fed.set_targets({"w1": _json_target(w1), "w2": _json_target(w2)})
+        fed.scrape_all(force=True)
+        srcs = fed.sources()
+        assert len(srcs) == 2  # local + ONE logical worker
+        s = parse_prometheus(fed.render_text())
+        assert s[("fedu_total", (("code", "200"), ("proc", "cluster")))] == 6.0
+
+    def test_cluster_summary_quantiles_from_merged_sketches(self):
+        reg, fed = _mk_fed()
+        gw = reg.histogram("fedq_ms", "lat", ("engine",),
+                           quantiles=(0.5, 0.99))
+        for v in range(100, 200):
+            gw.labels(engine="e").observe(float(v))
+        wreg = MetricsRegistry()
+        wh = wreg.histogram("fedq_ms", "lat", ("engine",),
+                            quantiles=(0.5, 0.99))
+        for v in range(100):
+            wh.labels(engine="e").observe(float(v))
+        w = {"identity": _ident("w", 111),
+             "exposition": wreg.render_prometheus(),
+             "sketches": wreg.export_sketches()}
+        fed.set_targets({"w": _json_target(w)})
+        fed.scrape_all(force=True)
+        s = parse_prometheus(fed.render_text())
+        base = (("engine", "e"), ("proc", "cluster"))
+        assert s[("fedq_ms_count", base)] == 200.0
+        assert s[("fedq_ms_sum", base)] == float(sum(range(200)))
+        med = s[("fedq_ms", base + (("quantile", "0.5"),))]
+        # honest cluster median over the union 0..199, not either proc's
+        assert 80.0 <= med <= 120.0
+
+    def test_render_parses_and_round_trips(self):
+        reg, fed = _mk_fed()
+        reg.counter("fedt_total", "t", ("code",)).labels(code="200").inc(2)
+        h = reg.histogram("fedt_ms", "lat", ("engine",))
+        h.labels(engine="e").observe(3.0)
+        w = {"identity": _ident("w", 111),
+             "exposition": _counter_expo("fedt_total", 8)}
+        fed.set_targets({"w": _json_target(w)})
+        fed.scrape_all(force=True)
+        text1 = fed.render_text()
+        s1 = parse_prometheus(text1)  # the whole render must parse
+        # deterministic: render → parse → render is a fixed point
+        assert parse_prometheus(fed.render_text()) == s1
+        # hierarchical: a second federator scraping this one's exposition
+        # preserves every per-proc series verbatim after its own render
+        _reg2, fed2 = _mk_fed()
+        parent = {"identity": _ident("gw1", 999),
+                  "exposition": text1}
+        fed2.set_targets({"gw1": _json_target(parent)})
+        fed2.scrape_all(force=True)
+        s2 = parse_prometheus(fed2.render_text())
+        for (name, labels), v in s1.items():
+            procs = dict(labels)
+            if procs.get("proc") in ("gateway", "w"):
+                assert s2[(name, labels)] == v, (name, labels)
+
+
+# -- Federator failure / staleness telemetry ----------------------------------
+
+
+class TestFederatorFailures:
+    def test_dead_worker_partial_scrape_and_staleness(self):
+        clk = FakeClock()
+        reg, fed = _mk_fed(interval=1.0, clock=clk)
+        w1 = {"identity": _ident("w1", 111),
+              "exposition": _counter_expo("fedf_total", 3)}
+        w2 = {"identity": _ident("w2", 222),
+              "exposition": _counter_expo("fedf_total", 4)}
+        fed.set_targets({"w1": _json_target(w1), "w2": _json_target(w2)})
+        fed.scrape_all(force=True)
+        assert not fed.is_stale("w2")
+        # w2 dies; scrapes keep succeeding for w1, failing for w2
+        w2["dead"] = True
+        clk.advance(1.1)
+        fed.scrape_all()
+        snap = fed.snapshot()["targets"]
+        assert snap["w1"]["scrapes_ok"] == 2
+        assert snap["w2"]["scrapes_failed"] == 1
+        assert "ConnectionRefused" in snap["w2"]["last_error"]
+        # failure counter by kind, on the gateway registry
+        s = parse_prometheus(reg.render_prometheus())
+        assert s[(
+            "obs_federation_scrape_failures_total",
+            (("gateway", "fed-test"), ("kind", "transport"),
+             ("worker", "w2")),
+        )] == 1.0
+        # staleness rises past the budget; w1 stays fresh
+        clk.advance(3.0)
+        assert fed.staleness_s("w2") > 3.0
+        assert fed.is_stale("w2") and not fed.is_stale("w1")
+        stale_v = s_after = parse_prometheus(reg.render_prometheus())[(
+            "obs_federation_staleness_seconds",
+            (("gateway", "fed-test"), ("worker", "w2")),
+        )]
+        assert stale_v > 3.0
+        # last-good state keeps rendering while dead (explicit, not blank)
+        sf = parse_prometheus(fed.render_text())
+        assert sf[("fedf_total", (("code", "200"), ("proc", "w2")))] == 4.0
+
+    def test_new_target_has_grace_not_instant_staleness(self):
+        clk = FakeClock()
+        _reg, fed = _mk_fed(interval=1.0, clock=clk)
+        fed.set_targets({"w": _json_target(
+            {"identity": _ident("w", 1),
+             "exposition": _counter_expo("g_total", 1)}
+        )})
+        assert fed.staleness_s("w") == 0.0 and not fed.is_stale("w")
+        clk.advance(3.5)  # never scraped: NOW it is stale
+        assert fed.is_stale("w")
+
+    def test_http_and_parse_failure_kinds(self):
+        reg, fed = _mk_fed()
+        fed.set_targets({
+            "w5xx": lambda path: (500, b"boom"),
+            "wbad": lambda path: (200, b'{"exposition": 3}'),
+        })
+        fed.scrape_all(force=True)
+        s = parse_prometheus(reg.render_prometheus())
+        base = (("gateway", "fed-test"),)
+        assert s[("obs_federation_scrape_failures_total",
+                  base + (("kind", "http"), ("worker", "w5xx")))] == 1.0
+        assert s[("obs_federation_scrape_failures_total",
+                  base + (("kind", "parse"), ("worker", "wbad")))] == 1.0
+
+    def test_fanout_debug_partial_results(self):
+        _reg, fed = _mk_fed()
+
+        def good(path):
+            return 200, json.dumps({
+                "proc_identity": _ident("w1", 111), "depth": 4,
+            }).encode()
+
+        def dead(path):
+            raise ConnectionRefusedError("gone")
+
+        fed.set_targets({"w1": good, "w2": dead})
+        out = fed.fanout_debug(
+            "/debug/flight", {"proc_identity": proc_identity(), "depth": 1}
+        )
+        assert out["scope"] == "cluster"
+        assert out["procs"]["gateway"]["depth"] == 1
+        assert out["procs"]["w1"]["depth"] == 4
+        assert out["errors"] == [
+            {"worker": 1, "error": out["errors"][0]["error"]}
+        ]
+        assert "ConnectionRefused" in out["errors"][0]["error"]
+
+    def test_close_removes_staleness_children(self):
+        reg, fed = _mk_fed()
+        fed.set_targets({"w": _json_target(
+            {"identity": _ident("w", 1),
+             "exposition": _counter_expo("c_total", 1)}
+        )})
+        assert 'worker="w"' in reg.render_prometheus()
+        fed.close()
+        assert (
+            "obs_federation_staleness_seconds{"
+            not in reg.render_prometheus()
+        )
+
+
+# -- cluster SLO feed ---------------------------------------------------------
+
+
+class _FakeSLO:
+    def __init__(self):
+        self.calls = []
+
+    def observe_batch(self, engine, code, latency_ms, n):
+        self.calls.append((engine, code, latency_ms, n))
+
+
+def _slo_expo(count, total, engine="w0", code="200"):
+    lab = f'engine="{engine}",code="{code}"'
+    return (
+        "# TYPE serving_request_latency_ms summary\n"
+        f"serving_request_latency_ms_count{{{lab}}} {count}\n"
+        f"serving_request_latency_ms_sum{{{lab}}} {total}\n"
+    )
+
+
+class TestClusterSLOFeed:
+    def test_deltas_replayed_under_cluster_engine(self):
+        slo = _FakeSLO()
+        reg = MetricsRegistry()
+        fed = Federator(
+            reg=reg, config=FederationConfig(scrape_interval_s=1.0),
+            clock=FakeClock(), slo=slo, slo_engine="clu",
+            gateway_label="fed-slo",
+        )
+        w = {"identity": _ident("w0", 111), "exposition": _slo_expo(10, 50)}
+        fed.set_targets({"w0": _json_target(w)})
+        fed.scrape_all(force=True)
+        assert slo.calls == []  # first sight primes, never replays history
+        w["exposition"] = _slo_expo(14, 70)
+        fed.scrape_all(force=True)
+        assert slo.calls == [("clu", 200, 5.0, 4)]  # (70-50)/4 ms each
+
+    def test_new_series_from_baselined_source_replays_fully(self):
+        # the bench-caught bug: an error burst creates a code="500"
+        # series the scraper has never seen — per-SOURCE priming must
+        # not swallow it as "history"; its whole count replays
+        slo = _FakeSLO()
+        reg = MetricsRegistry()
+        fed = Federator(
+            reg=reg, config=FederationConfig(scrape_interval_s=1.0),
+            clock=FakeClock(), slo=slo, slo_engine="clu",
+            gateway_label="fed-slo3",
+        )
+        w = {"identity": _ident("w0", 111), "exposition": _slo_expo(10, 50)}
+        fed.set_targets({"w0": _json_target(w)})
+        fed.scrape_all(force=True)
+        assert slo.calls == []
+        w["exposition"] = _slo_expo(10, 50) + _slo_expo(
+            24, 240, code="500")
+        fed.scrape_all(force=True)
+        assert slo.calls == [("clu", 500, 10.0, 24)]
+
+    def test_excluded_engine_and_burst_cap(self):
+        slo = _FakeSLO()
+        reg = MetricsRegistry()
+        fed = Federator(
+            reg=reg,
+            config=FederationConfig(
+                scrape_interval_s=1.0, slo_max_events_per_scrape=3
+            ),
+            clock=FakeClock(), slo=slo, slo_engine="clu",
+            slo_exclude_engines=("edge",), gateway_label="fed-slo2",
+        )
+        w = {"identity": _ident("w0", 111),
+             "exposition": _slo_expo(0, 0) + _slo_expo(
+                 5, 10, engine="edge", code="500")}
+        fed.set_targets({"w0": _json_target(w)})
+        fed.scrape_all(force=True)
+        w["exposition"] = _slo_expo(100, 400) + _slo_expo(
+            9, 20, engine="edge", code="500")
+        fed.scrape_all(force=True)
+        # excluded engine never replayed; big delta capped at 3 events
+        assert slo.calls == [("clu", 200, 4.0, 3)]
+
+
+# -- gateway integration (in-process workers) ---------------------------------
+
+
+FAST = dict(
+    failure_threshold=2, open_secs=0.2, backoff_base_ms=1.0,
+    backoff_max_ms=5.0, health_interval_s=0.05,
+)
+
+
+class TestGatewayFederation:
+    def test_gateway_federates_metrics_debug_and_healthz(self):
+        srv = DistributedServingServer(
+            _echo_factory(), n_workers=2, api_name="fedgw", port=0,
+            fabric=FabricConfig(**FAST),
+            federation=FederationConfig(scrape_interval_s=0.1),
+        )
+        srv.start()
+        try:
+            for _ in range(4):
+                status, _ = _post(srv.port, "fedgw", {"x": 1.0})
+                assert status == 200
+            time.sleep(0.3)  # let a scrape round land
+            status, body = _get(srv.port, "/metrics")
+            assert status == 200
+            text = body.decode()
+            assert 'proc="gateway"' in text and 'proc="cluster"' in text
+            s = parse_prometheus(text)
+            cluster_counts = {
+                k: v for k, v in s.items()
+                if k[0] == "serving_request_latency_ms_count"
+                and ("proc", "cluster") in k[1]
+            }
+            assert cluster_counts
+            # /healthz: federation block + cluster SLO view
+            status, body = _get(srv.port, "/healthz")
+            hz = json.loads(body)
+            fedblk = hz["federation"]
+            assert set(fedblk["targets"]) == {"worker-0", "worker-1"}
+            assert all(
+                t["scrapes_ok"] >= 1 for t in fedblk["targets"].values()
+            )
+            assert fedblk["slo_engine"] == srv.cluster_engine
+            assert "cluster_slos" in hz
+            # router view carries scrape-staleness annotations
+            workers = hz["router"]["workers"]
+            assert all("scrape_stale" in w for w in workers)
+            assert all(not w["scrape_stale"] for w in workers)
+            # ?scope=cluster debug fan-out (in-process workers share the
+            # gateway's identity, so they dedupe into one proc entry)
+            status, body = _get(srv.port, "/debug/memory?scope=cluster")
+            mem = json.loads(body)
+            assert mem["scope"] == "cluster" and mem["errors"] == []
+            assert "gateway" in mem["procs"]
+            gw_mem = mem["procs"]["gateway"]
+            assert gw_mem["proc_identity"]["pid"] == os.getpid()
+            status, body = _get(srv.port, "/debug/flight?scope=cluster")
+            fl = json.loads(body)
+            assert fl["scope"] == "cluster"
+            # stitched trace: pick a real trace id off the local ring
+            from mmlspark_tpu.obs.tracing import tracer
+
+            tid = next(
+                sp.trace_id for sp in tracer().spans() if sp.name == "http"
+            )
+            status, body = _get(
+                srv.port, f"/debug/trace?trace_id={tid}&scope=cluster"
+            )
+            tree = json.loads(body)
+            assert tree["scope"] == "cluster"
+            assert tree["trace_id"] == tid and tree["span_count"] >= 1
+            # federated JSON payload for hierarchical federation
+            status, body = _get(srv.port, "/metrics?sketches=1")
+            pj = json.loads(body)
+            assert pj["proc_identity"]["pid"] == os.getpid()
+            assert "serving_request_latency_ms" in pj["sketches"]
+        finally:
+            srv.stop()
+
+    def test_federation_disabled_keeps_plain_exposition(self):
+        srv = DistributedServingServer(
+            _echo_factory(), n_workers=1, api_name="fedoff", port=0,
+            fabric=FabricConfig(**FAST),
+            federation=FederationConfig(enabled=False),
+        )
+        srv.start()
+        try:
+            assert srv.federator is None
+            status, body = _get(srv.port, "/metrics")
+            assert status == 200
+            text = body.decode()
+            assert 'proc="cluster"' not in text
+            parse_prometheus(text)
+            status, body = _get(srv.port, "/healthz")
+            assert json.loads(body)["federation"] is None
+        finally:
+            srv.stop()
+
+
+# -- real subprocess worker ---------------------------------------------------
+
+
+def _subprocess_obs_worker(port_q, stop_q):
+    """Spawn-target: a real OS-process peer running its own ServingServer
+    with its own (empty-until-now) obs singletons. Serves one request to
+    itself so its registry and trace ring hold distinguishable state, then
+    parks until the parent signals."""
+    from mmlspark_tpu.obs.federation import set_proc_label
+    from mmlspark_tpu.obs.tracing import tracer
+
+    set_proc_label("subw-proc")
+
+    def handler(df):
+        parsed = parse_request(df, {"x": None})
+        vals = np.asarray([float(v) * 2.0 for v in parsed["x"]])
+        return make_reply(
+            parsed.with_column("y", vals, DataType.DOUBLE), "y"
+        )
+
+    srv = ServingServer(handler, api_name="subw", port=0)
+    srv.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request(
+            "POST", "/subw", body=json.dumps({"x": 2.0}),
+            headers={"Content-Type": "application/json"},
+        )
+        conn.getresponse().read()
+        conn.close()
+        tid = next(
+            sp.trace_id for sp in tracer().spans() if sp.name == "http"
+        )
+        port_q.put((srv.port, tid))
+        stop_q.get(timeout=120)
+    finally:
+        srv.stop()
+
+
+class TestSubprocessFederation:
+    def test_gateway_federates_a_real_subprocess_worker(self):
+        ctx = multiprocessing.get_context("spawn")
+        port_q, stop_q = ctx.Queue(), ctx.Queue()
+        proc = ctx.Process(
+            target=_subprocess_obs_worker, args=(port_q, stop_q),
+            daemon=True,
+        )
+        proc.start()
+        srv = None
+        try:
+            wport, wtid = port_q.get(timeout=120)
+            srv = DistributedServingServer(
+                _echo_factory(), n_workers=1, api_name="fedsub", port=0,
+                fabric=FabricConfig(**FAST),
+                federation=FederationConfig(
+                    scrape_interval_s=0.2,
+                    extra_targets=(("127.0.0.1", wport),),
+                ),
+            )
+            srv.start()
+            status, body = _get(srv.port, "/metrics", timeout=30)
+            assert status == 200
+            text = body.decode()
+            # the subprocess's serving series federate under its own proc
+            # label — a DIFFERENT process's registry, not ours
+            sub_series = re.findall(
+                r'serving_request_latency_ms_count\{[^}]*'
+                r'engine="subw-[^"]*"[^}]*\}', text
+            )
+            assert sub_series, text[:2000]
+            assert any('proc="extra-0"' in line for line in sub_series)
+            # its identity (pid != ours) shows in the federation snapshot
+            status, body = _get(srv.port, "/healthz", timeout=30)
+            ident = json.loads(body)["federation"]["targets"]["extra-0"][
+                "proc_identity"
+            ]
+            assert ident["proc"] == "subw-proc"
+            assert ident["pid"] != os.getpid()
+            # stitched cluster trace includes the subprocess's spans for a
+            # trace the gateway never saw locally
+            status, body = _get(
+                srv.port,
+                f"/debug/trace?trace_id={wtid}&scope=cluster", timeout=30,
+            )
+            tree = json.loads(body)
+            assert tree["scope"] == "cluster" and tree["errors"] == []
+            assert tree["trace_id"] == wtid
+            assert tree["span_count"] >= 1
+            names = set()
+
+            def walk(nodes):
+                for n in nodes:
+                    names.add(n["name"])
+                    walk(n.get("children", ()))
+
+            walk(tree["roots"])
+            assert "http" in names
+            # cluster-scope memory view carries the subprocess entry too
+            status, body = _get(
+                srv.port, "/debug/memory?scope=cluster", timeout=30
+            )
+            mem = json.loads(body)
+            assert "extra-0" in mem["procs"]
+            assert mem["procs"]["extra-0"]["proc_identity"]["pid"] == (
+                ident["pid"]
+            )
+        finally:
+            if srv is not None:
+                srv.stop()
+            stop_q.put(None)
+            proc.join(timeout=30)
+            if proc.is_alive():
+                proc.terminate()

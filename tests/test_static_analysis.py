@@ -186,6 +186,58 @@ def test_kernel_rule_package_scan_clean():
     assert check_kernel_fallback(paths, repo_root=REPO) == []
 
 
+# -- metric docs --------------------------------------------------------------
+
+
+def test_metric_docs_fires_and_suppresses():
+    from mmlspark_tpu.analysis.metric_docs import check_metric_docs
+
+    path = os.path.join(FIXTURES, "metric_docs_bad.py")
+    findings = check_metric_docs([path], repo_root=FIXTURES)
+    _assert_matches_markers("metric_docs_bad.py", findings)
+
+
+def test_metric_docs_table_extraction():
+    """Doc-table parsing honors the table idioms the real doc uses: a
+    trailing {label} group is labels, an interior brace group expands as
+    alternation, and prose mentions outside table rows do not count."""
+    from mmlspark_tpu.analysis.metric_docs import documented_families
+
+    names = documented_families(
+        "mentions `prose_only_total` in prose\n"
+        "| metric | source |\n"
+        "|---|---|\n"
+        "| `plain_total` | x |\n"
+        "| `labeled_ms{engine,code}` | x |\n"
+        "| `alt_{a,b}_{c,d}_total` | x |\n"
+    )
+    assert names == {
+        "plain_total", "labeled_ms",
+        "alt_a_c_total", "alt_a_d_total",
+        "alt_b_c_total", "alt_b_d_total",
+    }
+
+
+def test_metric_docs_missing_doc_flags_everything(tmp_path):
+    """With no docs/observability.md at the root, every registration is
+    undocumented — the rule must not silently pass on a doc-less tree."""
+    from mmlspark_tpu.analysis.metric_docs import check_metric_docs
+
+    mod = tmp_path / "m.py"
+    mod.write_text('reg.counter("lonely_total", "h")\n')
+    findings = check_metric_docs([str(mod)], repo_root=str(tmp_path))
+    assert [(f.rule, f.line) for f in findings] == [
+        ("undocumented-metric-family", 1)
+    ]
+
+
+def test_metric_docs_package_scan_clean():
+    """Every family the package registers appears in docs/observability.md's
+    metric tables — the contract this rule exists to pin."""
+    findings = run_all(REPO, select=["undocumented-metric-family"])
+    assert findings == [], [str(f) for f in findings]
+
+
 # -- batch loop ---------------------------------------------------------------
 
 

@@ -1,0 +1,140 @@
+"""obs_dump: snapshot a serving server's whole observability surface.
+
+One command fetches `/metrics?exemplars=1`, `/healthz`, and every
+`/debug/*` endpoint — at cluster scope when the target is a federated
+gateway (`--scope cluster`, the default tries cluster and falls back to
+local) — and writes a single timestamped JSON bundle for offline triage
+or attaching to a bug report:
+
+    python tools/obs_dump.py --host 127.0.0.1 --port 8080
+    python tools/obs_dump.py --port 8080 --out triage/ --scope local
+    python tools/obs_dump.py --port 8080 --trace-id 9f2c...   # + one tree
+
+The bundle carries every endpoint's payload (or its error — a dead
+endpoint never aborts the dump; partial evidence beats none), the target
+address, and the capture timestamps. Reads only; safe against production.
+"""
+
+import argparse
+import http.client
+import json
+import sys
+import time
+from datetime import datetime, timezone
+
+
+def fetch(host, port, path, timeout):
+    """(ok, payload) — payload is parsed JSON, exposition text, or the
+    error string. Never raises: the dump must survive dead endpoints."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        ctype = resp.getheader("Content-Type") or ""
+        if resp.status != 200:
+            return False, f"HTTP {resp.status}: {body[:200]!r}"
+        if "json" in ctype:
+            return True, json.loads(body.decode("utf-8"))
+        return True, body.decode("utf-8", "replace")
+    except (OSError, http.client.HTTPException, ValueError) as e:
+        return False, repr(e)
+    finally:
+        conn.close()
+
+
+def snapshot(host, port, scope="auto", trace_id=None, timeout=10.0):
+    """The bundle dict: every observability endpoint, captured once."""
+    cluster = "?scope=cluster"
+    endpoints = {
+        "metrics": "/metrics?exemplars=1",
+        "healthz": "/healthz",
+        "debug_flight": "/debug/flight",
+        "debug_memory": "/debug/memory",
+        "debug_trace": "/debug/trace",
+    }
+    if trace_id:
+        endpoints["trace_tree"] = f"/debug/trace?trace_id={trace_id}"
+    bundle = {
+        "target": f"{host}:{port}",
+        "captured_utc": datetime.now(timezone.utc).isoformat(),
+        "scope": scope,
+        "endpoints": {},
+        "errors": {},
+    }
+    for name, path in endpoints.items():
+        use = path
+        if scope in ("auto", "cluster") and name.startswith(("debug_", "trace_")):
+            sep = "&" if "?" in path else "?"
+            use = path + sep + cluster.lstrip("?")
+        t0 = time.monotonic()
+        ok, payload = fetch(host, port, use, timeout)
+        if not ok and scope == "auto" and use != path:
+            # not a federated gateway (or fan-out refused): local payload
+            use = path
+            ok, payload = fetch(host, port, use, timeout)
+        entry = {
+            "path": use,
+            "fetch_seconds": round(time.monotonic() - t0, 4),
+        }
+        if ok:
+            entry["payload"] = payload
+            bundle["endpoints"][name] = entry
+        else:
+            entry["error"] = payload
+            bundle["errors"][name] = entry
+    return bundle
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Snapshot /metrics + /healthz + /debug/* into one "
+        "timestamped JSON bundle for offline triage."
+    )
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument(
+        "--scope", choices=("auto", "cluster", "local"), default="auto",
+        help="cluster: require ?scope=cluster fan-out; local: never ask "
+        "for it; auto (default): try cluster, fall back to local",
+    )
+    ap.add_argument(
+        "--trace-id", default=None,
+        help="also capture /debug/trace?trace_id= for this trace",
+    )
+    ap.add_argument(
+        "--timeout", type=float, default=10.0,
+        help="per-endpoint fetch timeout in seconds",
+    )
+    ap.add_argument(
+        "--out", default=".",
+        help="output directory (or '-' to print the bundle to stdout)",
+    )
+    args = ap.parse_args(argv)
+    bundle = snapshot(
+        args.host, args.port, scope=args.scope,
+        trace_id=args.trace_id, timeout=args.timeout,
+    )
+    if args.out == "-":
+        json.dump(bundle, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0
+    import os
+
+    stamp = datetime.now(timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    path = os.path.join(
+        args.out, f"obs_dump_{args.host}_{args.port}_{stamp}.json"
+    )
+    os.makedirs(args.out, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(bundle, f, indent=2, sort_keys=True)
+    captured = sorted(bundle["endpoints"])
+    failed = sorted(bundle["errors"])
+    print(f"wrote {path} ({len(captured)} endpoints"
+          + (f", {len(failed)} failed: {failed}" if failed else "")
+          + ")")
+    return 0 if captured else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
